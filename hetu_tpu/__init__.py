@@ -1,0 +1,36 @@
+"""hetu-tpu: a TPU-native distributed deep-learning framework with the
+capabilities of Hetu (define-then-run dataflow graphs, DP via
+AllReduce/PS/Hybrid, TP via dispatch, pipeline parallelism, embedding
+cache), built on JAX/XLA/Pallas.
+
+Public API mirrors the reference (python/hetu/__init__.py): ``ht.Variable``,
+``*_op`` builders, ``ht.context``, ``ht.Executor``, ``ht.optim``,
+``ht.init``, ``ht.lr``, ``ht.data``, ``ht.dataloader_op``, device helpers.
+"""
+from .ndarray import (cpu, gpu, tpu, rcpu, rgpu, rtpu, array, empty,
+                      sparse_array, is_gpu_ctx, is_tpu_ctx, NDArray,
+                      ND_Sparse_Array, IndexedSlices, DLContext)
+from .context import context, get_current_context, DeviceGroup, NodeStatus
+from .graph.node import Op
+from .ops import *                                        # noqa: F401,F403
+from .ops.variable import Variable, placeholder_op, PlaceholderOp
+from .executor import (Executor, HetuConfig, SubExecutor, gradients,
+                       wrapped_mpi_nccl_init, new_group_comm,
+                       scheduler_init, scheduler_finish, worker_init,
+                       worker_finish, server_init, server_finish,
+                       get_worker_communicate)
+from .dataloader import Dataloader, DataloaderOp, dataloader_op, \
+    GNNDataLoaderOp
+from . import optimizer as optim
+from . import lr_scheduler as lr
+from . import initializers as init
+from . import data
+from . import metrics
+
+__version__ = "0.1.0"
+
+
+def mpi_nccl_init(init_nccl=True):
+    """Reference-compat: returns (comm, device_id)."""
+    comm = wrapped_mpi_nccl_init(init_nccl)
+    return comm, comm.rank

@@ -1,0 +1,358 @@
+"""Device placement and partition state.
+
+Reference parity: python/hetu/context.py — ``DeviceGroup`` (device-spec
+parsing, worker/server split), the ``with ht.context(...)`` stack, and
+``NodeStatus`` (per-node partition state: split counts per dim, replica
+count, device order).
+
+TPU-native twist: the reference *realizes* a NodeStatus by rewriting the
+graph with split/concat/add + NCCL send/recv (context.py:256-726). Here a
+NodeStatus lowers to a ``jax.sharding.PartitionSpec`` over a named mesh and
+XLA's SPMD partitioner materializes any resharding as ICI collectives —
+``NodeStatus.to_partition_spec`` is the entire planner.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+
+import numpy as np
+
+from .ndarray import DLContext, rcpu, rtpu, is_gpu_ctx
+
+__all__ = ["DeviceGroup", "NodeStatus", "context", "get_current_context",
+           "get_launch_config_by_traverse_nodes", "check_worker"]
+
+
+class DeviceGroup:
+    """An ordered set of device contexts; a tuple entry means those devices
+    cooperate on one model-parallel copy (reference context.py:7-96)."""
+
+    def __init__(self, ctxs):
+        self._contexts = self.parse_contexts(ctxs)
+        self._classify()
+
+    @classmethod
+    def parse_contexts(cls, ctxs):
+        if isinstance(ctxs, DeviceGroup):
+            return list(ctxs._contexts)
+        if isinstance(ctxs, str):
+            ctxs = re.split(";|,| +", ctxs.lower())
+        if not isinstance(ctxs, list):
+            ctxs = [ctxs]
+        parsed = []
+        for c in ctxs:
+            if isinstance(c, tuple):
+                c = tuple(x for x in (cls.str2ctx(cc) for cc in c)
+                          if x is not None)
+            else:
+                c = cls.str2ctx(c)
+            if c is not None:
+                parsed.append(c)
+        return parsed
+
+    @classmethod
+    def str2ctx(cls, c):
+        if isinstance(c, str):
+            parts = c.lower().split(":")
+            assert parts[-2] in ("cpu", "gpu", "tpu"), f"bad context: {c}"
+            hostname = "localhost" if len(parts) == 2 else parts[0]
+            idx = int(parts[-1])
+            if parts[-2] == "cpu":
+                return rcpu(hostname, idx)
+            return rtpu(hostname, idx)
+        assert c is None or isinstance(c, DLContext), f"bad context: {c}"
+        return c
+
+    def _classify(self):
+        self._workers, self._servers = [], []
+        for ctx in self._contexts:
+            if isinstance(ctx, tuple) or is_gpu_ctx(ctx):
+                self._workers.append(ctx)
+            else:
+                self._servers.append(ctx)
+
+    def index(self, ctx):
+        return self._contexts.index(ctx)
+
+    def __getitem__(self, key):
+        return self._contexts[key]
+
+    def __iter__(self):
+        return iter(self._contexts)
+
+    def __len__(self):
+        return len(self._contexts)
+
+    @property
+    def worker_num(self):
+        return len(self._workers)
+
+    @property
+    def server_num(self):
+        return len(self._servers)
+
+    @property
+    def workers(self):
+        return self._workers
+
+    @property
+    def servers(self):
+        return self._servers
+
+    def flat_workers(self):
+        """All worker device contexts, model-parallel tuples flattened."""
+        out = []
+        for w in self._workers:
+            out.extend(w if isinstance(w, tuple) else (w,))
+        return out
+
+    def get_sorted(self):
+        return DeviceGroup(sorted(
+            self._contexts, key=lambda x: hash(x.hostname) + hash(x.device_id)))
+
+    def __repr__(self):
+        body = []
+        for c in self._contexts:
+            body.append("(" + ", ".join(map(str, c)) + ")"
+                        if isinstance(c, tuple) else str(c))
+        return "DeviceGroup(" + ", ".join(body) + ")"
+
+    def __hash__(self):
+        if not hasattr(self, "_hash"):
+            self._hash = hash(tuple(self._contexts))
+        return self._hash
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceGroup) and hash(self) == hash(other)
+
+
+class _ContextStack:
+    def __init__(self):
+        self._stack = []
+
+    def peek(self):
+        return self._stack[-1] if self._stack else None
+
+    def push(self, ctx):
+        self._stack.append(ctx)
+
+    def pop(self):
+        self._stack.pop()
+
+
+_default_ctx_stack = _ContextStack()
+
+
+def get_current_context():
+    return _default_ctx_stack.peek()
+
+
+@contextlib.contextmanager
+def context(ctx):
+    try:
+        ctx = DeviceGroup(ctx)
+        _default_ctx_stack.push(ctx)
+        yield ctx
+    finally:
+        _default_ctx_stack.pop()
+
+
+def check_worker(ctx):
+    return isinstance(ctx, tuple) or is_gpu_ctx(ctx)
+
+
+class NodeStatus:
+    """Partition state of one graph node (reference context.py:116-193).
+
+    * ``state``     — tuple of split counts per tensor dim, e.g. (1, 2)
+                      splits dim 1 two ways.
+    * ``duplicate`` — number of replicas of each shard.
+    * ``order``     — device-order permutation over dims, -1 = replica axis.
+
+    ``to_partition_spec`` maps this onto mesh axis names: split dims bind to
+    model axes, the replica axis stays unsharded. XLA then inserts whatever
+    collectives a state transition needs — the TPU-native replacement for
+    the reference's cross_send/cross_receive planner (context.py:352-512).
+    """
+
+    def __init__(self, state=None, duplicate=None, order=None):
+        if isinstance(state, dict):
+            ndim = max(state) + 1 if state else 0
+            state = tuple(state.get(i, 1) for i in range(ndim))
+        self._state = tuple(state) if state is not None else None
+        self._duplicate = duplicate
+        self._order = tuple(order) if order is not None else None
+        self._defaulted = False
+        self._try_device_num()
+
+    @classmethod
+    def from_other(cls, other):
+        if other is None:
+            return cls(None, None, None)
+        return cls(other._state, other._duplicate, other._order)
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def state(self):
+        return self._state
+
+    @property
+    def duplicate(self):
+        return self._duplicate
+
+    @property
+    def order(self):
+        return self._order
+
+    @property
+    def device_num(self):
+        return self._device_num
+
+    def is_dist(self):
+        return not (self._state is None or all(x == 1 for x in self._state))
+
+    def get_default(self):
+        self._defaulted = True
+        if self._duplicate is None:
+            self._duplicate = 1
+        if self._order is None:
+            self._order = (-1,) + tuple(range(len(self._state)))
+        self._try_device_num()
+        return self._state, self._duplicate, self._order
+
+    def set_attr(self, duplicate, order):
+        if self._defaulted:
+            assert self._duplicate == duplicate and self._order == tuple(order)
+        else:
+            self._duplicate = duplicate
+            self._order = tuple(order)
+            self._try_device_num()
+
+    def set_state(self, state):
+        if isinstance(state, dict):
+            ndim = max(state) + 1 if state else 0
+            state = tuple(state.get(i, 1) for i in range(ndim))
+        self._state = tuple(state)
+        self._try_device_num()
+
+    def _try_device_num(self):
+        self._device_num = (
+            None if self._duplicate is None or self._state is None
+            else int(np.prod(self._state, dtype=int)) * self._duplicate)
+
+    def check_devices(self, devices):
+        assert self._device_num == len(devices), \
+            f"status wants {self._device_num} devices, got {len(devices)}"
+
+    # -- device-index algebra (kept for parity tests) -----------------------
+    def map_dev_to_index(self, global_index):
+        """Which shard coordinates the global_index-th device holds."""
+        coords = [0] * len(self._state)
+        for dim in self._order[::-1]:
+            if dim < 0:
+                global_index //= self._duplicate
+            else:
+                coords[dim] = global_index % self._state[dim]
+                global_index //= self._state[dim]
+        return coords
+
+    def get_loop_sizes(self):
+        loop_sizes = [1]
+        for dim in self._order[::-1]:
+            step = self._duplicate if dim < 0 else self._state[dim]
+            loop_sizes.insert(0, loop_sizes[0] * step)
+        loop_sizes.pop(0)
+        return loop_sizes
+
+    # -- TPU lowering -------------------------------------------------------
+    def to_partition_spec(self, mesh_axes=None):
+        """Lower to a jax PartitionSpec.
+
+        mesh_axes: mapping from tensor dim -> mesh axis name. By default the
+        i-th split dim (in order) binds to axis ``'mp%d' % k``; callers in
+        parallel/ pass explicit names ('dp', 'tp', ...).
+        """
+        from jax.sharding import PartitionSpec
+        if self._state is None or not self.is_dist():
+            return PartitionSpec()
+        spec = []
+        k = 0
+        for dim, parts in enumerate(self._state):
+            if parts > 1:
+                if mesh_axes and dim in mesh_axes:
+                    spec.append(mesh_axes[dim])
+                else:
+                    spec.append(f"mp{k}")
+                k += 1
+            else:
+                spec.append(None)
+        while spec and spec[-1] is None:
+            spec.pop()
+        return PartitionSpec(*spec)
+
+    def mesh_shape(self):
+        """(axis_names, sizes) for building a Mesh that fits this status."""
+        names, sizes = [], []
+        k = 0
+        for parts in self._state or ():
+            if parts > 1:
+                names.append(f"mp{k}")
+                sizes.append(parts)
+                k += 1
+        if self._duplicate and self._duplicate > 1:
+            names.append("dup")
+            sizes.append(self._duplicate)
+        return names, sizes
+
+    def __eq__(self, other):
+        return (isinstance(other, NodeStatus)
+                and self._state == other._state
+                and self._duplicate == other._duplicate
+                and self._order == other._order)
+
+    def __hash__(self):
+        return hash((self._state, self._duplicate, self._order))
+
+    def __repr__(self):
+        return (f"NodeStatus(state={self._state}, "
+                f"duplicate={self._duplicate}, order={self._order})")
+
+
+def get_launch_config_by_traverse_nodes(node_list, default_ctx):
+    """Infer per-node comm strategy + the device set (reference
+    context.py:216-254): a node whose group has servers uses PS; a node on
+    >1 workers uses AllReduce; else local."""
+    node_strategy = {}
+    devices = set()
+    for ctx in default_ctx:
+        devices.update(ctx if isinstance(ctx, tuple) else (ctx,))
+    launch_ps = default_ctx.server_num > 0 and default_ctx.worker_num > 0
+    launch_mpi = (not launch_ps) and default_ctx.worker_num > 1
+    nrank = default_ctx.worker_num
+
+    def visit(node):
+        if node in node_strategy:
+            return
+        strategy = None
+        raw = node.raw_ctx
+        if raw is not None and raw.server_num > 0 and raw.worker_num > 0:
+            strategy = "PS"
+        elif raw is not None and raw.worker_num > 1:
+            strategy = "AllReduce"
+        node_strategy[node] = strategy
+        if raw is not None:
+            for ctx in raw:
+                devices.update(ctx if isinstance(ctx, tuple) else (ctx,))
+            local_nrank = raw.worker_num
+            assert local_nrank in (0, nrank), \
+                f"inconsistent worker counts: ({local_nrank}, {nrank})"
+        for n in node.inputs:
+            visit(n)
+
+    for node in node_list:
+        visit(node)
+    launch_ps = launch_ps or any(s == "PS" for s in node_strategy.values())
+    launch_mpi = launch_mpi or any(
+        s == "AllReduce" for s in node_strategy.values())
+    return launch_mpi, launch_ps, node_strategy, devices

@@ -1,0 +1,193 @@
+"""Data loading.
+
+Reference parity: python/hetu/dataloader.py — ``Dataloader`` (in-memory
+numpy batcher with a 3-slot prefetch ring and per-worker rank sharding)
+and ``DataloaderOp`` (a graph leaf serving named splits). The TPU version
+keeps the same API; "prefetch" is jax async ``device_put`` — the next
+batch's H2D DMA overlaps the current step's compute, which is what the
+reference's circular CPU-array queue + h2d stream achieved
+(dataloader.py:26-81).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .graph.node import Op
+from . import ndarray
+
+__all__ = ["Dataloader", "DataloaderOp", "dataloader_op", "GNNDataLoaderOp"]
+
+
+class Dataloader:
+    def __init__(self, raw_data, batch_size, name="default", func=None,
+                 drop_last=True, shuffle=False):
+        self.func = func if func else (lambda x: x)
+        self.raw_data = np.asarray(self.func(raw_data), np.float32)
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.name = str(name)
+        self.inited = False
+
+    def init_states(self, rank=None, nrank=None):
+        data = self.raw_data
+        # rank sharding applies only in multi-process launches; the
+        # single-controller SPMD executor feeds the global batch and shards
+        # it across devices at device_put time (executor._ingest).
+        if rank is not None and nrank is not None and nrank > 1:
+            cur_size = data.shape[0] // nrank
+            data = data[cur_size * rank: cur_size * (rank + 1)]
+        self.data = data
+        self.samples_num = len(data)
+        assert self.batch_size > 0
+        if self.drop_last:
+            self.batch_num = self.samples_num // self.batch_size
+        else:
+            self.batch_num = int(np.ceil(self.samples_num / self.batch_size))
+        assert self.batch_num > 0, "not enough samples for one batch"
+        self.shape = (self.batch_size,) + self.data.shape[1:]
+        self.seq = np.arange(self.samples_num)
+        self.batch_index = 0
+        self.epoch = 0
+        self.inited = True
+        self._maybe_reshuffle()
+
+    def _maybe_reshuffle(self):
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch + 1)
+            rng.shuffle(self.seq)
+
+    def get_arr(self):
+        if not self.inited:
+            self.init_states()
+        start = self.batch_index * self.batch_size
+        end = min(start + self.batch_size, self.samples_num)
+        batch = self.data[self.seq[start:end]]
+        self.batch_index += 1
+        if self.batch_index >= self.batch_num:
+            self.batch_index = 0
+            self.epoch += 1
+            self._maybe_reshuffle()
+        self.last_batch_size = batch.shape[0]
+        return batch
+
+    def get_next_arr(self):
+        if not self.inited:
+            self.init_states()
+        start = self.batch_index * self.batch_size
+        end = min(start + self.batch_size, self.samples_num)
+        return self.data[self.seq[start:end]]
+
+    def get_cur_shape(self):
+        return self.get_next_arr().shape
+
+
+class DataloaderOp(Op):
+    def __init__(self, dataloaders):
+        super().__init__(DataloaderOp, [], None)
+        if isinstance(dataloaders, Dataloader):
+            dataloaders = [dataloaders]
+        if isinstance(dataloaders, (list, tuple)):
+            self.dataloaders = {dl.name: dl for dl in dataloaders}
+        else:
+            self.dataloaders = dict(dataloaders)
+        self.name = "DataloaderOp%d(%s)" % (
+            self.id, "/".join(self.dataloaders.keys()))
+
+    def _dl(self, name):
+        if name in self.dataloaders:
+            return self.dataloaders[name]
+        return self.dataloaders["default"]
+
+    def get_batch_num(self, name):
+        dl = self._dl(name)
+        if not dl.inited:
+            dl.init_states()
+        return dl.batch_num
+
+    def get_arr(self, name):
+        return self._dl(name).get_arr()
+
+    def get_next_arr(self, name):
+        return self._dl(name).get_next_arr()
+
+    def get_cur_shape(self, name):
+        return self._dl(name).get_cur_shape()
+
+    def compute(self, input_vals, ectx):
+        raise AssertionError("dataloader values are injected by the executor")
+
+    def gradient(self, output_grad):
+        return None
+
+    def infer_shape(self, input_shapes):
+        raise AssertionError("dataloader shape comes from the active split")
+
+    def forward_hook(self, config):
+        # single-controller SPMD: executor feeds global batches, so no rank
+        # sharding here; multi-process launches set config.process_rank.
+        rank = getattr(config, "process_rank", None)
+        nrank = getattr(config, "process_nrank", None)
+        for dl in self.dataloaders.values():
+            if not dl.inited:
+                dl.init_states(rank=rank, nrank=nrank)
+
+    def backward_hook(self, config):
+        pass
+
+
+class GNNDataLoaderOp(Op):
+    """Double-buffered graph feed (reference dataloader.py:98-131): the
+    trainer sets the next graph with ``step`` while the current one trains."""
+
+    graph = None
+    nxt_graph = None
+
+    def __init__(self, handler, ctx=None):
+        super().__init__(GNNDataLoaderOp, [], ctx)
+        self.handler = handler
+        self.name = "GNNDataloaderOp%d" % self.id
+
+    def get_batch_num(self, name):
+        return None
+
+    def get_arr(self, name):
+        return self.handler(self.graph)
+
+    def get_next_arr(self, name):
+        return self.handler(self.nxt_graph)
+
+    def get_cur_shape(self, name):
+        return np.asarray(self.handler(self.nxt_graph)).shape
+
+    def compute(self, input_vals, ectx):
+        raise AssertionError("dataloader values are injected by the executor")
+
+    def gradient(self, output_grad):
+        return None
+
+    def infer_shape(self, input_shapes):
+        raise AssertionError("dataloader shape comes from the graph batch")
+
+    @classmethod
+    def step(cls, graph):
+        cls.graph = cls.nxt_graph
+        cls.nxt_graph = graph
+
+    def forward_hook(self, config):
+        pass
+
+    def backward_hook(self, config):
+        pass
+
+
+def dataloader_op(dataloaders):
+    """Build a DataloaderOp from [[data, batch_size, name?, func?], ...] or
+    Dataloader instances (reference dataloader.py:176-190)."""
+    out = []
+    for dl in dataloaders:
+        if isinstance(dl, Dataloader):
+            out.append(dl)
+        else:
+            out.append(Dataloader(*dl))
+    return DataloaderOp(out)

@@ -1,0 +1,538 @@
+"""Executor: define-then-run sessions compiled to XLA.
+
+Reference parity: python/hetu/gpu_ops/executor.py — ``Executor`` (multi-
+subgraph facade with save/load), ``HetuConfig`` (comm-mode inference,
+communicator bring-up, hook pass), ``SubExecutor`` (per-step execution).
+
+TPU-native architecture: where the reference interprets the topo order in
+Python per step — one ctypes kernel launch per op with manual stream/event
+routing (executor.py:1761-1843) — this executor *traces* the topo order
+through the ops' pure ``compute`` functions once per feed-shape signature
+and compiles the whole step (forward + backward + optimizer update, with
+parameter donation) into a single XLA program. Data-parallel reduction,
+tensor-parallel resharding and replication all ride the compiled program's
+SPMD partitioning over the device mesh: the reference's five CUDA streams,
+event graph, memory planner and NCCL group calls have no equivalent here
+because XLA owns scheduling, fusion, and collective insertion.
+
+Host-boundary ops (parameter-server push/pull, dataloaders) split the
+graph into compiled segments with host code between them, mirroring the
+reference's d2h-stream PS path (executor.py:1800-1825).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import ndarray
+from .context import (DeviceGroup, get_current_context,
+                      get_launch_config_by_traverse_nodes)
+from .graph.autodiff import (find_topo_sort, gradients, sum_node_list,
+                             topo_sort_with_hook)
+from .graph.node import ExecContext, Op
+from .dataloader import DataloaderOp, GNNDataLoaderOp
+from .optimizer import OptimizerOp
+from .ops.variable import PlaceholderOp
+from .ops.comm import (AllReduceCommunicateOp, ParameterServerCommunicateOp,
+                       ParameterServerSparsePullOp, PipelineReceiveOp,
+                       PipelineSendOp, DispatchOp)
+
+__all__ = ["Executor", "HetuConfig", "SubExecutor", "gradients",
+           "wrapped_mpi_nccl_init", "new_group_comm",
+           "scheduler_init", "scheduler_finish", "worker_init",
+           "worker_finish", "server_init", "server_finish",
+           "get_worker_communicate"]
+
+
+def _default_ctx():
+    from .ndarray import tpu, cpu
+    try:
+        devs = jax.local_devices()
+    except RuntimeError:
+        return cpu(0)
+    return tpu(0) if any(d.platform != "cpu" for d in devs) else cpu(0)
+
+
+class HetuConfig:
+    """Session configuration (reference executor.py:107-314).
+
+    Resolves the communication mode from device groups, builds the device
+    mesh, and runs the backward/forward hook pass that splices
+    communication ops into the graph.
+    """
+
+    def __init__(self, eval_node_list, train_name="default",
+                 val_name="default", ctx=None, seed=0, comm_mode=None,
+                 use_sparse_pull=True, cstable_policy=None, bsp=False,
+                 prefetch=True, enable_lazy=False, cache_bound=100,
+                 log_path=None, gpipe=False, pipedream=False,
+                 dynamic_memory=False, mesh=None, dtype=None):
+        self.eval_node_list = eval_node_list
+        self.train_name = train_name
+        self.val_name = val_name
+        self.seed = seed
+        self.comm_mode = comm_mode
+        self.use_sparse_pull = use_sparse_pull
+        self.cstable_policy = cstable_policy
+        self.bsp = bsp
+        self.prefetch = prefetch
+        self.enable_lazy = enable_lazy
+        self.cache_bound = cache_bound
+        self.log_path = log_path
+        self.use_gpipe = gpipe
+        self.use_pipedream = pipedream
+        self.dynamic_memory = dynamic_memory
+        self.dtype = dtype
+        self.ps_comm = None
+
+        ctx = ctx if ctx is not None else get_current_context()
+        ctx = ctx if ctx is not None else _default_ctx()
+        self.context = DeviceGroup(ctx)
+
+        launch_mpi, launch_ps, self.node_strategy, devices = \
+            get_launch_config_by_traverse_nodes(eval_node_list, self.context)
+        if self.comm_mode is None:
+            if launch_ps and launch_mpi:
+                self.comm_mode = "Hybrid"
+            elif launch_ps:
+                self.comm_mode = "PS"
+            elif launch_mpi:
+                self.comm_mode = "AllReduce"
+        self.nrank = max(1, self.context.worker_num)
+        self.rank = 0                 # single-controller SPMD
+        self.ps_nodes = []
+        self.spmd_axis = None         # set inside shard_map tracing only
+        self.node_status = {}         # TP planner output
+
+        # -- device mesh -----------------------------------------------
+        self.mesh = mesh
+        if self.mesh is None and self.comm_mode in ("AllReduce", "Hybrid"):
+            self.mesh = self._build_dp_mesh()
+
+        # hook pass: splice comm ops (reference executor.py:314)
+        topo_sort_with_hook(eval_node_list, self)
+        if self.comm_mode in ("PS", "Hybrid") or self.ps_nodes:
+            from .ps.client import get_default_client
+            self.ps_comm = get_default_client()
+
+        self.placeholder_to_arr_map = {}
+
+    def _build_dp_mesh(self):
+        from jax.sharding import Mesh
+        devs = np.asarray(jax.devices())
+        ndp = self.nrank
+        if ndp > len(devs):
+            raise RuntimeError(
+                f"device group wants {ndp} workers but only "
+                f"{len(devs)} devices are visible")
+        return Mesh(devs[:ndp], axis_names=("dp",))
+
+    # -- sharding helpers ---------------------------------------------------
+    def data_sharding(self, ndim):
+        """Batch-dim sharding for feeds under data parallelism."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh,
+                             P(*(("dp",) + (None,) * (ndim - 1))))
+
+    def replicated_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def spec_for(self, node):
+        """PartitionSpec for a node assigned by the TP planner."""
+        status = self.node_status.get(node)
+        if status is None:
+            return None
+        axes_map = getattr(node, "mesh_axes", None)
+        return status.to_partition_spec(axes_map)
+
+
+class SubExecutor:
+    """Executes one eval subgraph (reference executor.py:1340-1864).
+
+    Compilation model: per feed-shape signature, run an eager shape-
+    inference pass (replaces the reference's infer_shape + memory_plan),
+    then trace+jit one step function. Parameters, batchnorm state and
+    optimizer slots thread functionally with donated buffers.
+    """
+
+    def __init__(self, name, eval_node_list, config):
+        self.name = name
+        self.eval_node_list = eval_node_list
+        self.config = config
+        self.topo_order = find_topo_sort(eval_node_list)
+
+        self.optimizer_ops = [n for n in self.topo_order
+                              if isinstance(n, OptimizerOp)]
+        self.training = bool(self.optimizer_ops)
+        self.dataloader_ops = [n for n in self.topo_order
+                               if isinstance(n, (DataloaderOp,
+                                                 GNNDataLoaderOp))]
+        self.param_nodes = [n for n in self.topo_order
+                            if isinstance(n, PlaceholderOp)
+                            and (n.tensor_value is not None
+                                 or n.initializer is not None)]
+        self.feed_nodes = [n for n in self.topo_order
+                           if isinstance(n, PlaceholderOp)
+                           and n not in self.param_nodes]
+        self.stateful_ops = [n for n in self.topo_order
+                             if getattr(n, "stateful", False)]
+        self.ps_ops = [n for n in self.topo_order
+                       if isinstance(n, (ParameterServerCommunicateOp,
+                                         ParameterServerSparsePullOp))]
+        self.compiled = {}
+        self.step_count = 0
+        self.batch_num = None
+        for dl in self.dataloader_ops:
+            if isinstance(dl, DataloaderOp):
+                bn = dl.get_batch_num(self.name)
+                self.batch_num = bn if self.batch_num is None \
+                    else min(self.batch_num, bn)
+
+    # ------------------------------------------------------------------
+    def _shape_key(self, feed_map):
+        key = []
+        for node in self.feed_nodes + self.dataloader_ops:
+            v = feed_map[node]
+            if isinstance(v, ndarray.CSRValue):
+                key.append(("csr", v.data.shape, v.nrow, v.ncol))
+            else:
+                key.append((tuple(v.shape), str(v.dtype)))
+        return tuple(key)
+
+    def _infer_shapes(self, feed_map):
+        shapes = {}
+        for node in self.topo_order:
+            if node in feed_map:
+                v = feed_map[node]
+                shape = ((v.nrow, v.ncol)
+                         if isinstance(v, ndarray.CSRValue)
+                         else tuple(v.shape))
+            elif isinstance(node, PlaceholderOp):
+                shape = tuple(node.shape)
+            else:
+                shape = node.infer_shape(
+                    [inp.inferred_shape for inp in node.inputs])
+            node.inferred_shape = shape
+            shapes[node] = shape
+        return shapes
+
+    def _ensure_state(self, executor):
+        """Initialize batchnorm-style op state once shapes are known."""
+        for node in self.stateful_ops:
+            sid = str(node.id)
+            if sid in executor.state:
+                continue
+            shapes = node.state_shapes(
+                [inp.inferred_shape for inp in node.inputs])
+            init = {}
+            for k, shp in shapes.items():
+                fill = 1.0 if "var" in k else 0.0
+                init[k] = jnp.full(shp, fill, dtype=jnp.float32)
+            executor.state[sid] = init
+
+    def _build_step(self):
+        topo = self.topo_order
+        config = self.config
+        training = self.training
+        feed_order = list(self.feed_nodes) + list(self.dataloader_ops)
+        param_order = list(self.param_nodes)
+        state_order = list(self.stateful_ops)
+        eval_nodes = self.eval_node_list
+        optimizer_set = set(self.optimizer_ops)
+
+        def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
+            ectx = ExecContext(training=training, base_rng=rng,
+                               config=config)
+            ectx.params = {n: params[str(n.id)] for n in param_order}
+            ectx.state = {n: state[str(n.id)] for n in state_order}
+            ectx.opt_state = opt_state
+            ectx.lr = lr
+            ectx.step = step_idx
+            env = {}
+            for n, v in zip(feed_order, feeds):
+                env[n] = v
+            for node in topo:
+                if node in env:
+                    continue
+                if node in ectx.params:
+                    env[node] = ectx.params[node]
+                    continue
+                env[node] = node.compute(
+                    [env[i] for i in node.inputs], ectx)
+            outputs = [None if n in optimizer_set else env[n]
+                       for n in eval_nodes]
+            new_params = {str(n.id): ectx.new_params.get(
+                n, params[str(n.id)]) for n in param_order}
+            new_state = {str(n.id): ectx.new_state.get(
+                n, state[str(n.id)]) for n in state_order}
+            new_opt = (ectx.new_opt_state if ectx.new_opt_state is not None
+                       else opt_state)
+            return outputs, new_params, new_state, new_opt
+
+        donate = (0, 2) if training else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def run(self, executor, feed_dict=None, convert_to_numpy_ret_vals=False):
+        assert not self.ps_ops or executor.ps_runtime is not None, \
+            "PS-mode graph requires the parameter-server runtime"
+        if self.ps_ops:
+            return executor.ps_runtime.run_step(
+                self, feed_dict, convert_to_numpy_ret_vals)
+        feed_dict = feed_dict or {}
+
+        feed_map = {}
+        for node, value in feed_dict.items():
+            feed_map[node] = self._ingest(value)
+        for dl in self.dataloader_ops:
+            feed_map[dl] = self._ingest(dl.get_arr(self.name))
+
+        key = self._shape_key(feed_map)
+        if key not in self.compiled:
+            self._infer_shapes(feed_map)
+            self._ensure_state(executor)
+            self.compiled[key] = self._build_step()
+        fn = self.compiled[key]
+
+        lr = jnp.float32(0.0)
+        for opt in self.optimizer_ops:
+            lr = jnp.float32(opt.optimizer.learning_rate)
+        feeds = [feed_map[n] for n in
+                 (list(self.feed_nodes) + list(self.dataloader_ops))]
+        outputs, new_params, new_state, new_opt = fn(
+            executor.params, executor.state, executor.opt_state, feeds,
+            lr, jnp.int32(self.step_count), executor.rngkey(self.step_count))
+        if self.training:
+            executor.params = new_params
+            executor.state = new_state
+            executor.opt_state = new_opt
+            for opt in self.optimizer_ops:
+                opt.optimizer.lr_sched.step()
+        self.step_count += 1
+
+        results = []
+        for out in outputs:
+            if out is None:
+                results.append(None)
+            elif convert_to_numpy_ret_vals:
+                results.append(np.asarray(out))
+            else:
+                results.append(ndarray.NDArray(out, _default_ctx()))
+        return results
+
+    def _ingest(self, value):
+        """Host value -> device value (with DP batch sharding)."""
+        if isinstance(value, ndarray.ND_Sparse_Array):
+            return ndarray.CSRValue.from_sparse_array(value)
+        if isinstance(value, ndarray.CSRValue):
+            return value
+        if isinstance(value, ndarray.NDArray):
+            value = value.jax_array
+        arr = value if isinstance(value, jax.Array) else np.asarray(value)
+        sharding = self.config.data_sharding(arr.ndim)
+        if sharding is not None and arr.shape and \
+                arr.shape[0] % self.config.nrank == 0:
+            return jax.device_put(arr, sharding)
+        return jax.device_put(arr)
+
+
+class Executor:
+    """Session facade over one or more eval subgraphs
+    (reference executor.py:317-455)."""
+
+    def __init__(self, eval_node_dict, config=None, **kargs):
+        if not isinstance(eval_node_dict, dict):
+            eval_node_dict = {"default": eval_node_dict}
+        self.eval_node_dict = eval_node_dict
+        all_eval_nodes = []
+        for nodes in eval_node_dict.values():
+            for n in nodes:
+                if n not in all_eval_nodes:
+                    all_eval_nodes.append(n)
+        if config is None:
+            config = HetuConfig(eval_node_list=all_eval_nodes, **kargs)
+        self.config = config
+
+        # -- parameter materialization ---------------------------------
+        self.params = {}
+        self.state = {}
+        self.opt_state = {}
+        self.ps_runtime = None
+        self._param_nodes = {}
+        topo = find_topo_sort(all_eval_nodes)
+        repl = config.replicated_sharding()
+        for node in topo:
+            if isinstance(node, PlaceholderOp) and (
+                    node.tensor_value is not None
+                    or node.initializer is not None):
+                value = node.initial_value(seed=config.seed)
+                spec = config.spec_for(node)
+                if spec is not None and config.mesh is not None:
+                    from jax.sharding import NamedSharding
+                    arr = jax.device_put(
+                        value, NamedSharding(config.mesh, spec))
+                elif repl is not None:
+                    arr = jax.device_put(value, repl)
+                else:
+                    arr = jax.device_put(value)
+                self.params[str(node.id)] = arr
+                self._param_nodes[str(node.id)] = node
+                config.placeholder_to_arr_map[node] = arr
+
+        # -- optimizer slots -------------------------------------------
+        for nodes in eval_node_dict.values():
+            for n in find_topo_sort(nodes):
+                if isinstance(n, OptimizerOp):
+                    by_node = {p: self.params[str(p.id)]
+                               for p in n.optimizer.params
+                               if str(p.id) in self.params}
+                    self.opt_state.update(n.optimizer.init_state(by_node))
+
+        self._base_rng = jax.random.PRNGKey(config.seed)
+        self.subexecutors = {
+            name: SubExecutor(name, nodes, config)
+            for name, nodes in eval_node_dict.items()}
+
+        # -- PS runtime ------------------------------------------------
+        if config.ps_comm is not None:
+            from .ps.runtime import PSRuntime
+            self.ps_runtime = PSRuntime(self, config)
+
+    def rngkey(self, step):
+        return jax.random.fold_in(self._base_rng, step)
+
+    # ------------------------------------------------------------------
+    def run(self, name="default", eval_node_list=None, feed_dict=None,
+            convert_to_numpy_ret_vals=False, **kwargs):
+        if isinstance(name, dict) and feed_dict is None:
+            # positional style: run(feed_dict)
+            feed_dict = name
+            name = "default"
+        if name not in self.subexecutors and "default" in self.subexecutors:
+            name = "default"
+        return self.subexecutors[name].run(
+            self, feed_dict, convert_to_numpy_ret_vals)
+
+    def get_batch_num(self, name="default"):
+        return self.subexecutors[name].batch_num
+
+    @property
+    def batch_num(self):
+        assert len(self.subexecutors) == 1
+        return next(iter(self.subexecutors.values())).batch_num
+
+    # ------------------------------------------------------------------
+    def save(self, file_path, file_name=None):
+        """One .npy per trainable parameter (reference executor.py:376-434)
+        plus optimizer slots / step counters in a sidecar pickle."""
+        os.makedirs(file_path, exist_ok=True)
+        for sid, node in self._param_nodes.items():
+            np.save(os.path.join(file_path, node.name + ".npy"),
+                    np.asarray(self.params[sid]))
+        sidecar = {
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+            "state": jax.tree_util.tree_map(np.asarray, self.state),
+            "id_to_name": {sid: node.name
+                           for sid, node in self._param_nodes.items()},
+        }
+        with open(os.path.join(file_path, file_name or "session.ckpt"),
+                  "wb") as f:
+            pickle.dump(sidecar, f)
+        if self.ps_runtime is not None:
+            self.ps_runtime.save(file_path)
+
+    def load(self, file_path, file_name=None):
+        for sid, node in self._param_nodes.items():
+            path = os.path.join(file_path, node.name + ".npy")
+            if os.path.exists(path):
+                value = np.load(path)
+                self.params[sid] = jax.device_put(
+                    value, self.params[sid].sharding)
+        ckpt = os.path.join(file_path, file_name or "session.ckpt")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                sidecar = pickle.load(f)
+            self.opt_state = jax.tree_util.tree_map(
+                jnp.asarray, sidecar["opt_state"])
+            self.state = jax.tree_util.tree_map(
+                jnp.asarray, sidecar["state"])
+        if self.ps_runtime is not None:
+            self.ps_runtime.load(file_path)
+
+    def recordLoads(self):
+        if self.config.ps_comm is not None:
+            return self.config.ps_comm.get_loads()
+        return {}
+
+    def __del__(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# launcher-compat API (reference executor.py exports)
+# ---------------------------------------------------------------------------
+
+def wrapped_mpi_nccl_init(init_nccl=True, devices=None):
+    """Reference boots MPI+NCCL here (executor.py:42-50). TPU runtime:
+    ``jax.distributed`` handles multi-host bring-up; in-process SPMD needs
+    nothing. Returns a shim exposing rank/nrank."""
+
+    class _Comm:
+        rank = 0
+        nrank = max(1, jax.device_count())
+
+        def dev_id(self):
+            return 0
+
+    return _Comm()
+
+
+def new_group_comm(devices=None):
+    """Device-subgroup communicator (reference executor.py:53-60) — under
+    XLA collectives, subgroup = mesh sub-axis; nothing to allocate."""
+    return None
+
+
+def scheduler_init():
+    from .ps.server import ensure_scheduler
+    ensure_scheduler()
+
+
+def scheduler_finish():
+    from .ps.server import shutdown_scheduler
+    shutdown_scheduler()
+
+
+def server_init():
+    from .ps.server import ensure_server
+    ensure_server()
+
+
+def server_finish():
+    from .ps.server import shutdown_server
+    shutdown_server()
+
+
+def worker_init():
+    from .ps.client import get_default_client
+    get_default_client()
+
+
+def worker_finish():
+    from .ps.client import close_default_client
+    close_default_client()
+
+
+def get_worker_communicate():
+    from .ps.client import get_default_client
+    return get_default_client()

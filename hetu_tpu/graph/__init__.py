@@ -1,0 +1,5 @@
+from .node import Op, ExecContext, reset_node_ids
+from .autodiff import gradients, find_topo_sort, sum_node_list
+
+__all__ = ["Op", "ExecContext", "reset_node_ids", "gradients",
+           "find_topo_sort", "sum_node_list"]

@@ -1,0 +1,88 @@
+"""Evaluation metrics (reference parity: python/hetu/metrics.py — numpy
+confusion-matrix metrics and AUC)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["accuracy", "precision", "recall", "f1_score", "auc",
+           "confusion_matrix", "ConfusionMatrix"]
+
+
+def _to_labels(y, axis=-1):
+    y = np.asarray(y)
+    if y.ndim > 1 and y.shape[axis] > 1:
+        return np.argmax(y, axis=axis)
+    return y.reshape(-1).astype(np.int64)
+
+
+def confusion_matrix(y_pred, y_true, num_classes=None):
+    p = _to_labels(y_pred)
+    t = _to_labels(y_true)
+    if num_classes is None:
+        num_classes = int(max(p.max(), t.max())) + 1
+    cm = np.zeros((num_classes, num_classes), dtype=np.int64)
+    np.add.at(cm, (t, p), 1)
+    return cm
+
+
+def accuracy(y_pred, y_true):
+    p = _to_labels(y_pred)
+    t = _to_labels(y_true)
+    return float((p == t).mean())
+
+
+def precision(y_pred, y_true, cls=1):
+    cm = confusion_matrix(y_pred, y_true)
+    denom = cm[:, cls].sum()
+    return float(cm[cls, cls] / denom) if denom else 0.0
+
+
+def recall(y_pred, y_true, cls=1):
+    cm = confusion_matrix(y_pred, y_true)
+    denom = cm[cls, :].sum()
+    return float(cm[cls, cls] / denom) if denom else 0.0
+
+
+def f1_score(y_pred, y_true, cls=1):
+    p = precision(y_pred, y_true, cls)
+    r = recall(y_pred, y_true, cls)
+    return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+def auc(y_score, y_true):
+    """ROC AUC by rank statistic (reference metrics.py AUC)."""
+    y_score = np.asarray(y_score).reshape(-1)
+    y_true = np.asarray(y_true).reshape(-1)
+    order = np.argsort(y_score)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(y_score) + 1)
+    # average ranks for ties
+    uniq, inv, counts = np.unique(y_score, return_inverse=True,
+                                  return_counts=True)
+    cum = np.cumsum(counts)
+    avg_rank = (cum - (counts - 1) / 2.0)
+    ranks = avg_rank[inv]
+    n_pos = y_true.sum()
+    n_neg = len(y_true) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float((ranks[y_true == 1].sum()
+                  - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class ConfusionMatrix:
+    """Streaming confusion-matrix accumulator."""
+
+    def __init__(self, num_classes):
+        self.num_classes = num_classes
+        self.cm = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def update(self, y_pred, y_true):
+        self.cm += confusion_matrix(y_pred, y_true, self.num_classes)
+
+    def accuracy(self):
+        total = self.cm.sum()
+        return float(np.trace(self.cm) / total) if total else 0.0
+
+    def reset(self):
+        self.cm[:] = 0

@@ -1,0 +1,324 @@
+"""Communication and placement ops.
+
+Reference parity:
+  * gpu_ops/AllReduceCommunicate.py  -> AllReduceCommunicateOp
+  * gpu_ops/ParameterServerCommunicate.py -> PS push/pull ops
+  * gpu_ops/DataTransfer.py          -> datah2d/datad2h
+  * gpu_ops/PipelineSend.py / PipelineReceive.py -> stage boundary markers
+  * gpu_ops/Dispatch.py              -> dispatch (TP repartition marker)
+
+TPU-native semantics: inside a single SPMD-compiled step, data-parallel
+gradient reduction is *implicit* — XLA inserts the all-reduce over ICI when
+a replicated parameter's gradient is contracted from batch-sharded values.
+AllReduceCommunicateOp therefore:
+  * under plain jit+shardings: asserts the replicated sharding (a no-op
+    marker XLA folds away),
+  * under shard_map (explicit-collective mode, ``ectx.spmd_axis`` set):
+    issues ``lax.pmean`` — matching the reference's loss-equivalence
+    semantics (summed grads / global batch).
+
+PS ops are *host boundaries*: the executor cuts the jit region at these
+nodes and performs push/pull through the C++ parameter-server client
+between compiled segments (reference runs them on the d2h stream for the
+same reason — they leave the device world, executor.py:1800-1825).
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..graph.node import Op
+from ..context import NodeStatus
+
+__all__ = [
+    "allreduceCommunicate_op", "groupallreduceCommunicate_op",
+    "parameterServerCommunicate_op", "parameterServerSparsePull_op",
+    "datah2d_op", "datad2h_op", "pipeline_send_op", "pipeline_receive_op",
+    "dispatch", "AllReduceCommunicateOp", "ParameterServerCommunicateOp",
+    "ParameterServerSparsePullOp", "PipelineSendOp", "PipelineReceiveOp",
+    "DispatchOp", "DispatchGradientOp",
+]
+
+
+class AllReduceCommunicateOp(Op):
+    def __init__(self, node_A, comm=None, ctx=None):
+        super().__init__(AllReduceCommunicateOp, [node_A], ctx)
+        self.comm = comm
+        self.use_indexed_slices = False
+
+    def compute(self, input_vals, ectx):
+        from ..ndarray import IndexedSlices
+        val = input_vals[0]
+        axis = getattr(ectx, "spmd_axis", None) or (
+            ectx.config.spmd_axis if ectx.config is not None else None)
+        if axis is None:
+            # single-program SPMD: gradient is already globally reduced by
+            # the partitioner; this node is a marker.
+            return val
+        if isinstance(val, IndexedSlices):
+            # sparse grads: all-gather indices+values (reference
+            # AllReduceCommunicate.py:25-53), then let the optimizer apply
+            # the combined slices.
+            idx = lax.all_gather(val.indices, axis, tiled=True)
+            vals = lax.all_gather(val.values, axis, tiled=True)
+            nrank = lax.psum(1, axis)
+            return IndexedSlices(idx, vals / nrank, val.dense_shape)
+        return lax.pmean(val, axis)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+    def forward_hook(self, config):
+        super().forward_hook(config)
+        self.comm = getattr(config, "nccl_comm", None)
+
+
+class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
+    """All-reduce within a device subgroup (model-parallel replica groups,
+    reference AllReduceCommunicate.py:92-123). The subgroup becomes a mesh
+    sub-axis; lowering is identical."""
+
+    def __init__(self, node_A, group=None, ctx=None):
+        super().__init__(node_A, ctx=ctx)
+        self.group = group
+
+
+class ParameterServerCommunicateOp(Op):
+    """Push a gradient to the PS (and pull back the updated parameter).
+
+    Executor contract: this node is a *host op* — never traced. The
+    SubExecutor schedules it between jit segments, calling the PS client
+    (push_pull / sparse_push) exactly like the reference's
+    _compute_asp_prefetch path (ParameterServerCommunicate.py:38-70).
+    """
+
+    def __init__(self, node_A, parameter, optimizer_info=None, ctx=None):
+        super().__init__(ParameterServerCommunicateOp, [node_A], ctx)
+        self.parameter = parameter
+        self.optimizer_info = optimizer_info
+        self.host_op = True
+
+    def compute(self, input_vals, ectx):
+        raise AssertionError("PS communicate is a host op; the executor "
+                             "must not trace it")
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class ParameterServerSparsePullOp(Op):
+    """Pull embedding rows for given indices from the PS (inference /
+    prefetch path, reference ParameterServerCommunicate.py:236-288)."""
+
+    def __init__(self, parameter, index, ctx=None):
+        super().__init__(ParameterServerSparsePullOp, [index], ctx)
+        self.parameter = parameter
+        self.host_op = True
+
+    def compute(self, input_vals, ectx):
+        raise AssertionError("PS sparse pull is a host op")
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return tuple(input_shapes[0]) + (self.parameter.shape[-1],)
+
+
+class DataH2DOp(Op):
+    """Host->device transfer. Under jit, placement is carried by shardings;
+    this is an identity marker kept for reference API parity
+    (DataTransfer.py)."""
+
+    def __init__(self, node_A, ctx=None):
+        super().__init__(DataH2DOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0]
+
+    def gradient(self, output_grad):
+        return [datad2h_op(output_grad, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class DataD2HOp(Op):
+    def __init__(self, node_A, ctx=None):
+        super().__init__(DataD2HOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0]
+
+    def gradient(self, output_grad):
+        return [datah2d_op(output_grad, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class PipelineSendOp(Op):
+    """Stage-boundary marker: the value leaves this pipeline stage
+    (reference PipelineSend.py). The pipeline executor moves the traced
+    value to the next stage's devices (ICI DMA via device_put / ppermute);
+    within a traced stage it is identity."""
+
+    def __init__(self, node_A, destination=None, comm=None, ctx=None):
+        super().__init__(PipelineSendOp, [node_A], ctx)
+        self.destination = destination
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0]
+
+    def gradient(self, output_grad):
+        return [pipeline_receive_op(source=self.destination,
+                                    ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class PipelineReceiveOp(Op):
+    """Stage-boundary marker: a value enters this stage from another
+    (reference PipelineReceive.py). The executor binds its value from the
+    upstream stage's send."""
+
+    def __init__(self, source=None, comm=None, ctx=None):
+        super().__init__(PipelineReceiveOp, [], ctx)
+        self.source = source
+        self.bound_send = None   # wired by the pipeline planner
+
+    def compute(self, input_vals, ectx):
+        raise AssertionError("pipeline receive must be bound by the "
+                             "pipeline executor")
+
+    def gradient(self, output_grad):
+        return [pipeline_send_op(output_grad, destination=self.source,
+                                 ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        assert self.bound_send is not None
+        return self.bound_send.inferred_shape
+
+
+class DispatchOp(Op):
+    """Marks the desired partition of its input (reference Dispatch.py).
+
+    ``parts`` is a tuple of split counts per dim (-1 = duplicate axis).
+    Planning turns it into a NodeStatus; at trace time the executor applies
+    ``lax.with_sharding_constraint`` so XLA repartitions here — the whole
+    split/concat/send/recv machinery of the reference collapses into one
+    sharding annotation.
+    """
+
+    def __init__(self, node_A, parts, ctx=None):
+        super().__init__(DispatchOp, [node_A], ctx)
+        if isinstance(parts, dict):
+            ndim = max(parts) + 1 if parts else 0
+            parts = tuple(parts.get(i, 1) for i in range(ndim))
+        self.parts = tuple(parts)
+
+    def target_status(self):
+        state = tuple(p if p > 0 else 1 for p in self.parts)
+        dup = 1
+        for p in self.parts:
+            if p < 0:
+                dup *= -p
+        st = NodeStatus(state, duplicate=dup)
+        st.get_default()
+        return st
+
+    def compute(self, input_vals, ectx):
+        val = input_vals[0]
+        spec = None
+        if ectx.config is not None and ectx.config.mesh is not None:
+            spec = ectx.config.spec_for(self)
+        if spec is not None:
+            val = lax.with_sharding_constraint(
+                val, jax.sharding.NamedSharding(ectx.config.mesh, spec))
+        return val
+
+    def gradient(self, output_grad):
+        return [DispatchGradientOp(output_grad, self.inputs[0],
+                                   ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        target = self.target_status()
+        status.set_state(target.state)
+        status.set_attr(target.duplicate, target.order)
+
+
+class DispatchGradientOp(Op):
+    """Gradient of a dispatch mirrors the forward *input's* partition
+    (reference Dispatch.py:50-65)."""
+
+    def __init__(self, node_A, forward_input, ctx=None):
+        super().__init__(DispatchGradientOp, [node_A], ctx)
+        self.forward_input = forward_input
+
+    def compute(self, input_vals, ectx):
+        val = input_vals[0]
+        if ectx.config is not None and ectx.config.mesh is not None:
+            spec = ectx.config.spec_for(self.forward_input)
+            if spec is not None:
+                val = lax.with_sharding_constraint(
+                    val, jax.sharding.NamedSharding(ectx.config.mesh, spec))
+        return val
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def allreduceCommunicate_op(node, comm=None, ctx=None):
+    return AllReduceCommunicateOp(node, comm=comm, ctx=ctx)
+
+
+def groupallreduceCommunicate_op(node, group=None, ctx=None):
+    return GroupAllReduceCommunicateOp(node, group=group, ctx=ctx)
+
+
+def parameterServerCommunicate_op(node, parameter, optimizer_info=None,
+                                  ctx=None):
+    return ParameterServerCommunicateOp(node, parameter, optimizer_info,
+                                        ctx=ctx)
+
+
+def parameterServerSparsePull_op(parameter, index, ctx=None):
+    return ParameterServerSparsePullOp(parameter, index, ctx=ctx)
+
+
+def datah2d_op(node, ctx=None):
+    return DataH2DOp(node, ctx=ctx)
+
+
+def datad2h_op(node, ctx=None):
+    return DataD2HOp(node, ctx=ctx)
+
+
+def pipeline_send_op(node, destination=None, comm=None, stream=None,
+                     ctx=None):
+    return PipelineSendOp(node, destination=destination, ctx=ctx)
+
+
+def pipeline_receive_op(source=None, comm=None, stream=None, ctx=None):
+    return PipelineReceiveOp(source=source, ctx=ctx)
+
+
+def dispatch(node, parts, ctx=None):
+    return DispatchOp(node, parts, ctx=ctx)

@@ -1,0 +1,330 @@
+"""Convolution and pooling ops (NCHW).
+
+Reference parity: gpu_ops/{Conv2d,MaxPool,AvgPool,Conv2dBroadcast,
+Conv2dReduceSum}.py over src/ops/{Conv2d,CudnnConv2d,*Pool}.cu. Forward
+ops lower to ``lax.conv_general_dilated`` / ``lax.reduce_window`` (MXU /
+vector-unit friendly); the explicit gradient ops compute the exact
+transpose convolutions via ``jax.vjp`` of the forward primitive — XLA
+emits the same fused kernels it would for ``jax.grad``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph.node import Op
+
+__all__ = [
+    "conv2d_op", "conv2d_gradient_of_data_op", "conv2d_gradient_of_filter_op",
+    "max_pool2d_op", "max_pool2d_gradient_op", "avg_pool2d_op",
+    "avg_pool2d_gradient_op", "conv2d_broadcastto_op", "conv2d_reducesum_op",
+]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv(data, filt, stride, padding):
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    return lax.conv_general_dilated(
+        data, filt, window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+class Conv2dOp(Op):
+    def __init__(self, node_A, node_B, padding=0, stride=1, ctx=None):
+        super().__init__(Conv2dOp, [node_A, node_B], ctx)
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        return _conv(input_vals[0], input_vals[1], self.stride, self.padding)
+
+    def gradient(self, output_grad):
+        return [conv2d_gradient_of_data_op(self.inputs[1], output_grad,
+                                           self.inputs[0],
+                                           padding=self.padding,
+                                           stride=self.stride,
+                                           ctx=self.raw_ctx),
+                conv2d_gradient_of_filter_op(self.inputs[0], output_grad,
+                                             self.inputs[1],
+                                             padding=self.padding,
+                                             stride=self.stride,
+                                             ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        n, _, h, w = input_shapes[0]
+        o, _, kh, kw = input_shapes[1]
+        ph, pw = _pair(self.padding)
+        sh, sw = _pair(self.stride)
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return (n, o, oh, ow)
+
+
+class Conv2dGradientOfDataOp(Op):
+    """inputs: (filter, grad_y[, data_ref]); output: grad wrt data."""
+
+    def __init__(self, node_filter, node_grad, node_data=None, padding=0,
+                 stride=1, ctx=None):
+        inputs = [node_filter, node_grad]
+        self.has_ref = node_data is not None
+        if self.has_ref:
+            inputs.append(node_data)
+        super().__init__(Conv2dGradientOfDataOp, inputs, ctx)
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        filt, grad = input_vals[0], input_vals[1]
+        data_shape = (input_vals[2].shape if self.has_ref
+                      else self.data_shape)
+        zeros = jnp.zeros(data_shape, dtype=grad.dtype)
+        _, vjp = jax.vjp(
+            lambda d: _conv(d, filt, self.stride, self.padding), zeros)
+        return vjp(grad)[0]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        if self.has_ref:
+            self.data_shape = tuple(input_shapes[2])
+        return self.data_shape
+
+
+class Conv2dGradientOfFilterOp(Op):
+    """inputs: (data, grad_y[, filter_ref]); output: grad wrt filter."""
+
+    def __init__(self, input_X, gradient_Y, node_filter=None, padding=0,
+                 stride=1, ctx=None):
+        inputs = [input_X, gradient_Y]
+        self.has_ref = node_filter is not None
+        if self.has_ref:
+            inputs.append(node_filter)
+        super().__init__(Conv2dGradientOfFilterOp, inputs, ctx)
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        data, grad = input_vals[0], input_vals[1]
+        filt_shape = (input_vals[2].shape if self.has_ref
+                      else self.filter_shape)
+        zeros = jnp.zeros(filt_shape, dtype=grad.dtype)
+        _, vjp = jax.vjp(
+            lambda f: _conv(data, f, self.stride, self.padding), zeros)
+        return vjp(grad)[0]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        if self.has_ref:
+            self.filter_shape = tuple(input_shapes[2])
+        return self.filter_shape
+
+
+def _pool_dims(shape, kh, kw, padding, stride):
+    n, c, h, w = shape
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    return (n, c, oh, ow)
+
+
+def _max_pool(x, kh, kw, padding, stride):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, kh, kw), (1, 1, sh, sw),
+        ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+
+
+def _avg_pool(x, kh, kw, padding, stride):
+    ph, pw = _pair(padding)
+    sh, sw = _pair(stride)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, sh, sw),
+        ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    return summed / (kh * kw)
+
+
+class MaxPool2dOp(Op):
+    def __init__(self, node_A, kernel_H, kernel_W, padding=0, stride=1,
+                 ctx=None):
+        super().__init__(MaxPool2dOp, [node_A], ctx)
+        self.kernel_H = kernel_H
+        self.kernel_W = kernel_W
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        return _max_pool(input_vals[0], self.kernel_H, self.kernel_W,
+                         self.padding, self.stride)
+
+    def gradient(self, output_grad):
+        return [max_pool2d_gradient_op(self, output_grad, self.inputs[0],
+                                       self.kernel_H, self.kernel_W,
+                                       self.padding, self.stride,
+                                       ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return _pool_dims(input_shapes[0], self.kernel_H, self.kernel_W,
+                          self.padding, self.stride)
+
+
+class MaxPool2dGradientOp(Op):
+    def __init__(self, node_out, node_out_gradient, node_in, kernel_H,
+                 kernel_W, padding=0, stride=1, ctx=None):
+        super().__init__(MaxPool2dGradientOp,
+                         [node_out, node_out_gradient, node_in], ctx)
+        self.kernel_H = kernel_H
+        self.kernel_W = kernel_W
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        _, grad, x = input_vals
+        _, vjp = jax.vjp(
+            lambda v: _max_pool(v, self.kernel_H, self.kernel_W,
+                                self.padding, self.stride), x)
+        return vjp(grad)[0]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[2]
+
+
+class AvgPool2dOp(Op):
+    def __init__(self, node_A, kernel_H, kernel_W, padding=0, stride=1,
+                 ctx=None):
+        super().__init__(AvgPool2dOp, [node_A], ctx)
+        self.kernel_H = kernel_H
+        self.kernel_W = kernel_W
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        return _avg_pool(input_vals[0], self.kernel_H, self.kernel_W,
+                         self.padding, self.stride)
+
+    def gradient(self, output_grad):
+        return [avg_pool2d_gradient_op(self, output_grad, self.inputs[0],
+                                       self.kernel_H, self.kernel_W,
+                                       self.padding, self.stride,
+                                       ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return _pool_dims(input_shapes[0], self.kernel_H, self.kernel_W,
+                          self.padding, self.stride)
+
+
+class AvgPool2dGradientOp(Op):
+    def __init__(self, node_out, node_out_gradient, node_in, kernel_H,
+                 kernel_W, padding=0, stride=1, ctx=None):
+        super().__init__(AvgPool2dGradientOp,
+                         [node_out, node_out_gradient, node_in], ctx)
+        self.kernel_H = kernel_H
+        self.kernel_W = kernel_W
+        self.padding = padding
+        self.stride = stride
+
+    def compute(self, input_vals, ectx):
+        _, grad, x = input_vals
+        _, vjp = jax.vjp(
+            lambda v: _avg_pool(v, self.kernel_H, self.kernel_W,
+                                self.padding, self.stride), x)
+        return vjp(grad)[0]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[2]
+
+
+class Conv2dBroadcastToOp(Op):
+    """Broadcast a bias (C,) over an NCHW activation (reference
+    Conv2dBroadcast.py)."""
+
+    def __init__(self, node_A, node_B, ctx=None):
+        super().__init__(Conv2dBroadcastToOp, [node_A, node_B], ctx)
+
+    def compute(self, input_vals, ectx):
+        bias, ref = input_vals
+        return jnp.broadcast_to(bias.reshape(1, -1, 1, 1), ref.shape)
+
+    def gradient(self, output_grad):
+        return [conv2d_reducesum_op(output_grad, ctx=self.raw_ctx), None]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+class Conv2dReduceSumOp(Op):
+    """Reduce an NCHW tensor to per-channel sums (C,) — the bias gradient
+    (reference Conv2dReduceSum.py)."""
+
+    def __init__(self, node_A, ctx=None):
+        super().__init__(Conv2dReduceSumOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return jnp.sum(input_vals[0], axis=(0, 2, 3))
+
+    def gradient(self, output_grad):
+        return [conv2d_broadcastto_op(output_grad, self.inputs[0],
+                                      ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return (input_shapes[0][1],)
+
+
+def conv2d_op(node_A, node_B, padding=0, stride=1, ctx=None):
+    return Conv2dOp(node_A, node_B, padding=padding, stride=stride, ctx=ctx)
+
+
+def conv2d_gradient_of_data_op(node_filter, node_grad, node_data=None,
+                               padding=0, stride=1, ctx=None):
+    return Conv2dGradientOfDataOp(node_filter, node_grad, node_data,
+                                  padding=padding, stride=stride, ctx=ctx)
+
+
+def conv2d_gradient_of_filter_op(input_X, gradient_Y, node_filter=None,
+                                 padding=0, stride=1, ctx=None):
+    return Conv2dGradientOfFilterOp(input_X, gradient_Y, node_filter,
+                                    padding=padding, stride=stride, ctx=ctx)
+
+
+def max_pool2d_op(node_A, kernel_H, kernel_W, padding=0, stride=1, ctx=None):
+    return MaxPool2dOp(node_A, kernel_H, kernel_W, padding, stride, ctx=ctx)
+
+
+def max_pool2d_gradient_op(node_out, node_out_gradient, node_in, kernel_H,
+                           kernel_W, padding=0, stride=1, ctx=None):
+    return MaxPool2dGradientOp(node_out, node_out_gradient, node_in,
+                               kernel_H, kernel_W, padding, stride, ctx=ctx)
+
+
+def avg_pool2d_op(node_A, kernel_H, kernel_W, padding=0, stride=1, ctx=None):
+    return AvgPool2dOp(node_A, kernel_H, kernel_W, padding, stride, ctx=ctx)
+
+
+def avg_pool2d_gradient_op(node_out, node_out_gradient, node_in, kernel_H,
+                           kernel_W, padding=0, stride=1, ctx=None):
+    return AvgPool2dGradientOp(node_out, node_out_gradient, node_in,
+                               kernel_H, kernel_W, padding, stride, ctx=ctx)
+
+
+def conv2d_broadcastto_op(node_A, node_B, ctx=None):
+    return Conv2dBroadcastToOp(node_A, node_B, ctx=ctx)
+
+
+def conv2d_reducesum_op(node_A, ctx=None):
+    return Conv2dReduceSumOp(node_A, ctx=ctx)

@@ -1,0 +1,74 @@
+"""Embedding lookup with sparse (IndexedSlices) gradients.
+
+Reference parity: gpu_ops/EmbeddingLookUp.py. Forward is a gather (XLA maps
+it to efficient HBM reads); the gradient is an :class:`IndexedSlices`
+carried through the graph as a pytree value, so optimizers can apply a
+scatter-add update without densifying the table — the property that lets
+the reference scale to trillion-parameter tables (PS path) is preserved by
+keeping the slices sparse all the way to the update (or to the PS client).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..graph.node import Op
+from ..ndarray import IndexedSlices
+
+__all__ = ["embedding_lookup_op", "embedding_lookup_gradient_op",
+           "EmbeddingLookUp", "EmbeddingLookUpGradient"]
+
+
+class EmbeddingLookUp(Op):
+    def __init__(self, embedding, index, ctx=None):
+        super().__init__(EmbeddingLookUp, [embedding, index], ctx)
+        from .variable import PlaceholderOp
+        if isinstance(embedding, PlaceholderOp):
+            embedding.is_embed = True
+
+    def compute(self, input_vals, ectx):
+        table, idx = input_vals
+        return jnp.take(table, idx.astype(jnp.int32), axis=0)
+
+    def gradient(self, output_grad):
+        grad = embedding_lookup_gradient_op(
+            output_grad, self.inputs[1], self, ctx=self.raw_ctx)
+        return [grad, None]
+
+    def infer_shape(self, input_shapes):
+        emb_shape, idx_shape = input_shapes
+        return tuple(idx_shape) + (emb_shape[-1],)
+
+
+class EmbeddingLookUpGradient(Op):
+    """Produces an IndexedSlices pytree (reference
+    EmbeddingLookUp_Gradient:88-108)."""
+
+    def __init__(self, vectors, index, forward_node=None, embed_shape=None,
+                 ctx=None):
+        super().__init__(EmbeddingLookUpGradient, [vectors, index], ctx)
+        self.forward_node = forward_node
+        self.embed_shape = embed_shape
+
+    def compute(self, input_vals, ectx):
+        grad, idx = input_vals
+        return IndexedSlices(indices=idx.astype(jnp.int32), values=grad,
+                             dense_shape=self.embed_shape)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        if self.embed_shape is None:
+            self.embed_shape = tuple(
+                self.forward_node.inputs[0].inferred_shape)
+        return tuple(self.embed_shape)
+
+
+def embedding_lookup_op(embedding, index, ctx=None):
+    return EmbeddingLookUp(embedding, index, ctx=ctx)
+
+
+def embedding_lookup_gradient_op(vectors, index, forward_node=None,
+                                 embed_shape=None, ctx=None):
+    return EmbeddingLookUpGradient(vectors, index, forward_node=forward_node,
+                                   embed_shape=embed_shape, ctx=ctx)

@@ -1,0 +1,365 @@
+"""Normalization ops.
+
+Reference parity: gpu_ops/{BatchNorm,LayerNorm,InstanceNorm2d}.py. The
+reference packs (dx, dscale, dbias) into one gradient kernel and unpacks
+with *_gradient_of_data/scale/bias ops; we keep that graph structure — the
+packed gradient op returns a tuple value (graph values are pytrees under
+jit) and the unpack ops index it.
+
+Batch-norm running statistics are functional op state: ``compute`` reads
+``ectx.state[self]`` and writes ``ectx.put_state`` — the executor threads
+them between steps like parameters (no in-place buffers).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..graph.node import Op
+
+__all__ = [
+    "batch_normalization_op", "batch_normalization_gradient_op",
+    "batch_normalization_gradient_of_data_op",
+    "batch_normalization_gradient_of_scale_op",
+    "batch_normalization_gradient_of_bias_op",
+    "layer_normalization_op", "layer_normalization_gradient_op",
+    "layer_normalization_gradient_of_data_op",
+    "layer_normalization_gradient_of_scale_op",
+    "layer_normalization_gradient_of_bias_op",
+    "instance_normalization2d_op", "instance_normalization2d_gradient_op",
+]
+
+
+def _bcast_c(v):
+    """Reshape a (C,)/(1,C,1,1) param to broadcast over NCHW."""
+    return v.reshape(1, -1, 1, 1)
+
+
+class BatchNormalizationOp(Op):
+    def __init__(self, node_in, bn_scale, bn_bias, momentum=0.99, eps=0.01,
+                 ctx=None):
+        super().__init__(BatchNormalizationOp,
+                         [node_in, bn_scale, bn_bias], ctx)
+        self.momentum = momentum
+        self.eps = eps
+        self.stateful = True
+
+    def state_shapes(self, input_shapes):
+        c = input_shapes[0][1]
+        return {"running_mean": (c,), "running_var": (c,)}
+
+    def compute(self, input_vals, ectx):
+        x, scale, bias = input_vals
+        axes = (0, 2, 3)
+        state = ectx.get_state(self)
+        if ectx.training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            if state is not None:
+                m = self.momentum
+                ectx.put_state(self, {
+                    "running_mean": m * state["running_mean"] + (1 - m) * mean,
+                    "running_var": m * state["running_var"] + (1 - m) * var,
+                })
+        else:
+            assert state is not None, "inference BN needs running stats"
+            mean, var = state["running_mean"], state["running_var"]
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        xhat = (x - _bcast_c(mean)) * _bcast_c(inv)
+        return xhat * _bcast_c(scale) + _bcast_c(bias)
+
+    def gradient(self, output_grad):
+        packed = batch_normalization_gradient_op(
+            output_grad, self.inputs[0], self.inputs[1], self, self.eps,
+            ctx=self.raw_ctx)
+        return [
+            batch_normalization_gradient_of_data_op(packed, self.inputs[0],
+                                                    ctx=self.raw_ctx),
+            batch_normalization_gradient_of_scale_op(packed, self.inputs[1],
+                                                     ctx=self.raw_ctx),
+            batch_normalization_gradient_of_bias_op(packed, self.inputs[2],
+                                                    ctx=self.raw_ctx),
+        ]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class BatchNormalizationGradientOp(Op):
+    """Packed (dx, dscale, dbias) — closed-form BN backward over batch
+    statistics (reference BatchNorm.py:96-159 / src/ops/BatchNorm.cu)."""
+
+    def __init__(self, out_gradient, in_node, bn_scale, forward_node, eps,
+                 ctx=None):
+        super().__init__(BatchNormalizationGradientOp,
+                         [out_gradient, in_node, bn_scale], ctx)
+        self.forward_node = forward_node
+        self.eps = eps
+
+    def compute(self, input_vals, ectx):
+        dy, x, scale = input_vals
+        axes = (0, 2, 3)
+        n = x.shape[0] * x.shape[2] * x.shape[3]
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        xhat = (x - _bcast_c(mean)) * _bcast_c(inv)
+        dbias = jnp.sum(dy, axis=axes)
+        dscale = jnp.sum(dy * xhat, axis=axes)
+        dx = (_bcast_c(scale * inv) / n) * (
+            n * dy - _bcast_c(dbias) - xhat * _bcast_c(dscale))
+        return (dx, dscale, dbias)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        # packed value; consumers index it
+        return input_shapes[0]
+
+
+class _PackedIndexOp(Op):
+    idx = None
+
+    def __init__(self, op_type, packed, like_node, ctx=None):
+        super().__init__(op_type, [packed, like_node], ctx)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0][self.idx]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+class BatchNormalizationGradientOfDataOp(_PackedIndexOp):
+    idx = 0
+
+    def __init__(self, bn_gradient, in_arr, ctx=None):
+        super().__init__(BatchNormalizationGradientOfDataOp, bn_gradient,
+                         in_arr, ctx=ctx)
+
+
+class BatchNormalizationGradientOfScaleOp(_PackedIndexOp):
+    idx = 1
+
+    def __init__(self, bn_gradient, in_scale, ctx=None):
+        super().__init__(BatchNormalizationGradientOfScaleOp, bn_gradient,
+                         in_scale, ctx=ctx)
+
+    def compute(self, input_vals, ectx):
+        out = input_vals[0][self.idx]
+        return out.reshape(input_vals[1].shape)
+
+
+class BatchNormalizationGradientOfBiasOp(_PackedIndexOp):
+    idx = 2
+
+    def __init__(self, bn_gradient, in_bias, ctx=None):
+        super().__init__(BatchNormalizationGradientOfBiasOp, bn_gradient,
+                         in_bias, ctx=ctx)
+
+    def compute(self, input_vals, ectx):
+        out = input_vals[0][self.idx]
+        return out.reshape(input_vals[1].shape)
+
+
+class LayerNormalizationOp(Op):
+    def __init__(self, node_in, ln_scale, ln_bias, eps=0.01, ctx=None):
+        super().__init__(LayerNormalizationOp,
+                         [node_in, ln_scale, ln_bias], ctx)
+        self.eps = eps
+
+    def compute(self, input_vals, ectx):
+        x, scale, bias = input_vals
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xhat = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        return xhat * scale + bias
+
+    def gradient(self, output_grad):
+        packed = layer_normalization_gradient_op(
+            output_grad, self.inputs[0], self.inputs[1], self, self.eps,
+            ctx=self.raw_ctx)
+        return [
+            layer_normalization_gradient_of_data_op(packed, self.inputs[0],
+                                                    ctx=self.raw_ctx),
+            layer_normalization_gradient_of_scale_op(packed, self.inputs[1],
+                                                     ctx=self.raw_ctx),
+            layer_normalization_gradient_of_bias_op(packed, self.inputs[2],
+                                                    ctx=self.raw_ctx),
+        ]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class LayerNormalizationGradientOp(Op):
+    def __init__(self, out_gradient, in_node, ln_scale, forward_node, eps,
+                 ctx=None):
+        super().__init__(LayerNormalizationGradientOp,
+                         [out_gradient, in_node, ln_scale], ctx)
+        self.forward_node = forward_node
+        self.eps = eps
+
+    def compute(self, input_vals, ectx):
+        dy, x, scale = input_vals
+        d = x.shape[-1]
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        xhat = (x - mean) * inv
+        reduce_axes = tuple(range(x.ndim - 1))
+        dscale = jnp.sum(dy * xhat, axis=reduce_axes)
+        dbias = jnp.sum(dy, axis=reduce_axes)
+        dxhat = dy * scale
+        dx = inv / d * (
+            d * dxhat
+            - jnp.sum(dxhat, axis=-1, keepdims=True)
+            - xhat * jnp.sum(dxhat * xhat, axis=-1, keepdims=True))
+        return (dx, dscale, dbias)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class LayerNormalizationGradientOfDataOp(_PackedIndexOp):
+    idx = 0
+
+    def __init__(self, ln_gradient, in_arr, ctx=None):
+        super().__init__(LayerNormalizationGradientOfDataOp, ln_gradient,
+                         in_arr, ctx=ctx)
+
+
+class LayerNormalizationGradientOfScaleOp(_PackedIndexOp):
+    idx = 1
+
+    def __init__(self, ln_gradient, in_scale, ctx=None):
+        super().__init__(LayerNormalizationGradientOfScaleOp, ln_gradient,
+                         in_scale, ctx=ctx)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0][self.idx].reshape(input_vals[1].shape)
+
+
+class LayerNormalizationGradientOfBiasOp(_PackedIndexOp):
+    idx = 2
+
+    def __init__(self, ln_gradient, in_bias, ctx=None):
+        super().__init__(LayerNormalizationGradientOfBiasOp, ln_gradient,
+                         in_bias, ctx=ctx)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0][self.idx].reshape(input_vals[1].shape)
+
+
+class InstanceNormalization2dOp(Op):
+    def __init__(self, node_in, eps=0.01, ctx=None):
+        super().__init__(InstanceNormalization2dOp, [node_in], ctx)
+        self.eps = eps
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+        var = jnp.var(x, axis=(2, 3), keepdims=True)
+        return (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+
+    def gradient(self, output_grad):
+        return [instance_normalization2d_gradient_op(
+            output_grad, self.inputs[0], self, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class InstanceNormalization2dGradientOp(Op):
+    def __init__(self, out_gradient, in_node, forward_node, ctx=None):
+        super().__init__(InstanceNormalization2dGradientOp,
+                         [out_gradient, in_node], ctx)
+        self.forward_node = forward_node
+
+    def compute(self, input_vals, ectx):
+        dy, x = input_vals
+        eps = self.forward_node.eps
+        n = x.shape[2] * x.shape[3]
+        mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+        var = jnp.var(x, axis=(2, 3), keepdims=True)
+        inv = jnp.reciprocal(jnp.sqrt(var + eps))
+        xhat = (x - mean) * inv
+        dsum = jnp.sum(dy, axis=(2, 3), keepdims=True)
+        ddot = jnp.sum(dy * xhat, axis=(2, 3), keepdims=True)
+        return inv / n * (n * dy - dsum - xhat * ddot)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def batch_normalization_op(node_in, bn_scale, bn_bias, momentum=0.99,
+                           eps=0.01, ctx=None):
+    return BatchNormalizationOp(node_in, bn_scale, bn_bias,
+                                momentum=momentum, eps=eps, ctx=ctx)
+
+
+def batch_normalization_gradient_op(out_gradient, in_node, bn_scale,
+                                    forward_node, eps, ctx=None):
+    return BatchNormalizationGradientOp(out_gradient, in_node, bn_scale,
+                                        forward_node, eps, ctx=ctx)
+
+
+def batch_normalization_gradient_of_data_op(bn_gradient, in_arr, ctx=None):
+    return BatchNormalizationGradientOfDataOp(bn_gradient, in_arr, ctx=ctx)
+
+
+def batch_normalization_gradient_of_scale_op(bn_gradient, in_scale,
+                                             ctx=None):
+    return BatchNormalizationGradientOfScaleOp(bn_gradient, in_scale,
+                                               ctx=ctx)
+
+
+def batch_normalization_gradient_of_bias_op(bn_gradient, in_bias, ctx=None):
+    return BatchNormalizationGradientOfBiasOp(bn_gradient, in_bias, ctx=ctx)
+
+
+def layer_normalization_op(node_in, ln_scale, ln_bias, eps=0.01, ctx=None):
+    return LayerNormalizationOp(node_in, ln_scale, ln_bias, eps=eps, ctx=ctx)
+
+
+def layer_normalization_gradient_op(out_gradient, in_node, ln_scale,
+                                    forward_node, eps, ctx=None):
+    return LayerNormalizationGradientOp(out_gradient, in_node, ln_scale,
+                                        forward_node, eps, ctx=ctx)
+
+
+def layer_normalization_gradient_of_data_op(ln_gradient, in_arr, ctx=None):
+    return LayerNormalizationGradientOfDataOp(ln_gradient, in_arr, ctx=ctx)
+
+
+def layer_normalization_gradient_of_scale_op(ln_gradient, in_scale,
+                                             ctx=None):
+    return LayerNormalizationGradientOfScaleOp(ln_gradient, in_scale,
+                                               ctx=ctx)
+
+
+def layer_normalization_gradient_of_bias_op(ln_gradient, in_bias, ctx=None):
+    return LayerNormalizationGradientOfBiasOp(ln_gradient, in_bias, ctx=ctx)
+
+
+def instance_normalization2d_op(node_in, eps=0.01, ctx=None):
+    return InstanceNormalization2dOp(node_in, eps=eps, ctx=ctx)
+
+
+def instance_normalization2d_gradient_op(out_gradient, in_node, forward_node,
+                                         ctx=None):
+    return InstanceNormalization2dGradientOp(out_gradient, in_node,
+                                             forward_node, ctx=ctx)

@@ -1,0 +1,676 @@
+"""Shape-manipulation and reduction ops.
+
+Reference parity: gpu_ops/{Reshape,Broadcast,BroadcastShape,Concat,Split,
+Slice,Transpose,Pad,ReduceSum,ReduceMean,ReduceSumAxisZero,OnesLike,
+ZerosLike}.py. All become jnp/lax shape ops; under jit XLA turns most into
+free layout changes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..graph.node import Op
+
+__all__ = [
+    "array_reshape_op", "array_reshape_gradient_op", "broadcastto_op",
+    "broadcast_shape_op", "concat_op", "concat_gradient_op", "concatenate_op",
+    "split_op", "split_gradient_op", "slice_op", "slice_gradient_op",
+    "transpose_op", "pad_op", "pad_gradient_op", "unbroadcast_op",
+    "reduce_sum_op",
+    "reduce_mean_op", "reducesumaxiszero_op", "oneslike_op", "zeroslike_op",
+]
+
+
+class ArrayReshapeOp(Op):
+    def __init__(self, node_A, output_shape, ctx=None):
+        super().__init__(ArrayReshapeOp, [node_A], ctx)
+        self.output_shape = tuple(output_shape)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        shape = list(self.output_shape)
+        # support one -1 dim like the reference (Reshape.py)
+        if -1 in shape:
+            known = -int(np.prod([s for s in shape]))
+            total = int(np.prod(x.shape))
+            shape[shape.index(-1)] = total // known
+        return jnp.reshape(x, shape)
+
+    def gradient(self, output_grad):
+        return [array_reshape_gradient_op(output_grad, self,
+                                          ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        shape = list(self.output_shape)
+        if -1 in shape:
+            known = -int(np.prod(shape))
+            total = int(np.prod(input_shapes[0]))
+            shape[shape.index(-1)] = total // known
+        return tuple(shape)
+
+
+class ArrayReshapeGradientOp(Op):
+    def __init__(self, grad_node, forward_node, ctx=None):
+        super().__init__(ArrayReshapeGradientOp, [grad_node], ctx)
+        self.forward_node = forward_node
+
+    def compute(self, input_vals, ectx):
+        return jnp.reshape(input_vals[0], self.input_shape)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        self.input_shape = tuple(
+            self.forward_node.inputs[0].inferred_shape)
+        return self.input_shape
+
+
+class BroadcastToOp(Op):
+    """Broadcast node_A to the shape of node_B (reference Broadcast.py).
+    Standard numpy right-aligned broadcasting."""
+
+    def __init__(self, node_A, node_B, ctx=None):
+        super().__init__(BroadcastToOp, [node_A, node_B], ctx)
+
+    def compute(self, input_vals, ectx):
+        return jnp.broadcast_to(input_vals[0], input_vals[1].shape)
+
+    def gradient(self, output_grad):
+        return [unbroadcast_op(output_grad, self.inputs[0],
+                               ctx=self.raw_ctx),
+                None]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+class BroadcastShapeOp(Op):
+    """Broadcast to an explicit shape, optionally inserting new axes at
+    ``add_axes`` (reference BroadcastShape.py)."""
+
+    def __init__(self, node_A, shape, add_axes=(), ctx=None):
+        super().__init__(BroadcastShapeOp, [node_A], ctx)
+        self.shape = tuple(shape)
+        self.add_axes = tuple(add_axes)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        if self.add_axes:
+            for ax in sorted(self.add_axes):
+                x = jnp.expand_dims(x, ax)
+        return jnp.broadcast_to(x, self.shape)
+
+    def gradient(self, output_grad):
+        return [unbroadcast_op(output_grad, self.inputs[0],
+                               sum_axes=self.add_axes, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return self.shape
+
+
+class ConcatOp(Op):
+    def __init__(self, node_A, node_B, axis=0, ctx=None):
+        super().__init__(ConcatOp, [node_A, node_B], ctx)
+        self.axis = axis
+
+    def compute(self, input_vals, ectx):
+        return jnp.concatenate(input_vals, axis=self.axis)
+
+    def gradient(self, output_grad):
+        return [concat_gradient_op(output_grad, self.inputs[0], self.axis, 0,
+                                   ctx=self.raw_ctx),
+                concat_gradient_op(output_grad, self.inputs[1], self.axis, 1,
+                                   ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        a, b = list(input_shapes[0]), list(input_shapes[1])
+        out = list(a)
+        out[self.axis] = a[self.axis] + b[self.axis]
+        return tuple(out)
+
+
+class ConcatGradientOp(Op):
+    def __init__(self, grad_node, input_node, axis, idx, ctx=None):
+        super().__init__(ConcatGradientOp, [grad_node, input_node], ctx)
+        self.axis = axis
+        self.idx = idx
+
+    def compute(self, input_vals, ectx):
+        grad, ref = input_vals
+        size = ref.shape[self.axis]
+        # idx-th chunk along axis; offset known from sibling shape
+        if self.idx == 0:
+            start = 0
+        else:
+            start = grad.shape[self.axis] - size
+        index = [slice(None)] * grad.ndim
+        index[self.axis] = slice(start, start + size)
+        return grad[tuple(index)]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1]
+
+
+class ConcatenateOp(Op):
+    """N-ary concat (reference gpu_ops has 2-ary Concat; BERT builds N-ary
+    from pairs — we provide it natively)."""
+
+    def __init__(self, nodes, axis=0, ctx=None):
+        super().__init__(ConcatenateOp, list(nodes), ctx)
+        self.axis = axis
+
+    def compute(self, input_vals, ectx):
+        return jnp.concatenate(input_vals, axis=self.axis)
+
+    def gradient(self, output_grad):
+        grads = []
+        offset_nodes = self.inputs
+        for i, inp in enumerate(offset_nodes):
+            grads.append(ConcatenateGradientOp(
+                output_grad, self, i, self.axis, ctx=self.raw_ctx))
+        return grads
+
+    def infer_shape(self, input_shapes):
+        out = list(input_shapes[0])
+        out[self.axis] = sum(s[self.axis] for s in input_shapes)
+        return tuple(out)
+
+
+class ConcatenateGradientOp(Op):
+    def __init__(self, grad_node, forward_node, idx, axis, ctx=None):
+        super().__init__(ConcatenateGradientOp, [grad_node], ctx)
+        self.forward_node = forward_node
+        self.idx = idx
+        self.axis = axis
+
+    def compute(self, input_vals, ectx):
+        grad = input_vals[0]
+        sizes = [inp.inferred_shape[self.axis]
+                 for inp in self.forward_node.inputs]
+        start = sum(sizes[:self.idx])
+        index = [slice(None)] * grad.ndim
+        index[self.axis] = slice(start, start + sizes[self.idx])
+        return grad[tuple(index)]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return tuple(self.forward_node.inputs[self.idx].inferred_shape)
+
+
+class SplitOp(Op):
+    """Take the ``indices``-th piece when splitting each axis in ``axes``
+    into ``splits`` parts (reference Split.py)."""
+
+    def __init__(self, node_A, axes, indices, splits, ctx=None):
+        super().__init__(SplitOp, [node_A], ctx)
+        self.axes = list(axes)
+        self.indices = list(indices)
+        self.splits = list(splits)
+        assert len(self.axes) == len(self.splits) == len(self.indices)
+        assert all(x >= 0 for x in self.axes)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        index = [slice(None)] * x.ndim
+        for ax, ind, spl in zip(self.axes, self.indices, self.splits):
+            size = x.shape[ax] // spl
+            index[ax] = slice(ind * size, (ind + 1) * size)
+        return x[tuple(index)]
+
+    def gradient(self, output_grad):
+        return [split_gradient_op(output_grad, self.axes, self.indices,
+                                  self.splits, self, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        out = list(input_shapes[0])
+        for ax, spl in zip(self.axes, self.splits):
+            assert out[ax] % spl == 0
+            out[ax] //= spl
+        return tuple(out)
+
+
+class SplitGradientOp(Op):
+    def __init__(self, node_A, axes, indices, splits, forward_node=None,
+                 ctx=None):
+        super().__init__(SplitGradientOp, [node_A], ctx)
+        self.axes = list(axes)
+        self.indices = list(indices)
+        self.splits = list(splits)
+        self.forward_node = forward_node
+
+    def compute(self, input_vals, ectx):
+        grad = input_vals[0]
+        out_shape = list(grad.shape)
+        starts = [0] * grad.ndim
+        for ax, ind, spl in zip(self.axes, self.indices, self.splits):
+            out_shape[ax] = grad.shape[ax] * spl
+            starts[ax] = ind * grad.shape[ax]
+        out = jnp.zeros(out_shape, dtype=grad.dtype)
+        index = tuple(slice(s, s + grad.shape[i])
+                      for i, s in enumerate(starts))
+        return out.at[index].set(grad)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        out = list(input_shapes[0])
+        for ax, spl in zip(self.axes, self.splits):
+            out[ax] *= spl
+        return tuple(out)
+
+
+class SliceOp(Op):
+    def __init__(self, node_A, begin_pos, output_shape, ctx=None):
+        super().__init__(SliceOp, [node_A], ctx)
+        self.begin_pos = tuple(begin_pos)
+        self.output_shape = tuple(output_shape)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        sizes = [x.shape[i] - self.begin_pos[i] if s == -1 else s
+                 for i, s in enumerate(self.output_shape)]
+        index = tuple(slice(b, b + s)
+                      for b, s in zip(self.begin_pos, sizes))
+        return x[index]
+
+    def gradient(self, output_grad):
+        return [slice_gradient_op(output_grad, self.begin_pos,
+                                  self.output_shape, self,
+                                  ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        in_shape = input_shapes[0]
+        return tuple(in_shape[i] - self.begin_pos[i] if s == -1 else s
+                     for i, s in enumerate(self.output_shape))
+
+
+class SliceGradientOp(Op):
+    def __init__(self, node_A, begin_pos, output_shape=None,
+                 forward_node=None, ctx=None):
+        super().__init__(SliceGradientOp, [node_A], ctx)
+        self.begin_pos = tuple(begin_pos)
+        self.forward_node = forward_node
+
+    def compute(self, input_vals, ectx):
+        grad = input_vals[0]
+        out = jnp.zeros(self.full_shape, dtype=grad.dtype)
+        index = tuple(slice(b, b + s)
+                      for b, s in zip(self.begin_pos, grad.shape))
+        return out.at[index].set(grad)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        self.full_shape = tuple(self.forward_node.inputs[0].inferred_shape)
+        return self.full_shape
+
+
+class TransposeOp(Op):
+    def __init__(self, node_A, perm=None, ctx=None):
+        super().__init__(TransposeOp, [node_A], ctx)
+        self.perm = tuple(perm) if perm is not None else None
+
+    def compute(self, input_vals, ectx):
+        return jnp.transpose(input_vals[0], self.perm)
+
+    def gradient(self, output_grad):
+        if self.perm is None:
+            inv = None
+        else:
+            inv = tuple(np.argsort(self.perm))
+        return [transpose_op(output_grad, inv, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        shape = input_shapes[0]
+        perm = self.perm if self.perm is not None \
+            else tuple(reversed(range(len(shape))))
+        return tuple(shape[p] for p in perm)
+
+
+class PadOp(Op):
+    def __init__(self, node_A, paddings, mode="CONSTANT", constant_values=0,
+                 ctx=None):
+        super().__init__(PadOp, [node_A], ctx)
+        self.paddings = [tuple(p) for p in paddings]
+        self.mode = mode.upper()
+        self.constant_values = constant_values
+
+    def compute(self, input_vals, ectx):
+        mode = {"CONSTANT": "constant", "REFLECT": "reflect",
+                "SYMMETRIC": "symmetric"}[self.mode]
+        kwargs = {}
+        if mode == "constant":
+            kwargs["constant_values"] = self.constant_values
+        return jnp.pad(input_vals[0], self.paddings, mode=mode, **kwargs)
+
+    def gradient(self, output_grad):
+        return [pad_gradient_op(output_grad, self.paddings, self.mode,
+                                ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return tuple(s + p[0] + p[1]
+                     for s, p in zip(input_shapes[0], self.paddings))
+
+
+class PadGradientOp(Op):
+    def __init__(self, node_A, paddings, mode="CONSTANT", ctx=None):
+        super().__init__(PadGradientOp, [node_A], ctx)
+        self.paddings = [tuple(p) for p in paddings]
+        self.mode = mode.upper()
+
+    def compute(self, input_vals, ectx):
+        grad = input_vals[0]
+        if self.mode == "CONSTANT":
+            index = tuple(slice(p[0], grad.shape[i] - p[1])
+                          for i, p in enumerate(self.paddings))
+            return grad[index]
+        # REFLECT/SYMMETRIC: padded positions alias interior values, so
+        # the adjoint scatter-adds them back — take the exact vjp of pad
+        import jax
+        mode = {"REFLECT": "reflect", "SYMMETRIC": "symmetric"}[self.mode]
+        in_shape = tuple(s - p[0] - p[1]
+                         for s, p in zip(grad.shape, self.paddings))
+        zeros = jnp.zeros(in_shape, dtype=grad.dtype)
+        _, vjp = jax.vjp(
+            lambda x: jnp.pad(x, self.paddings, mode=mode), zeros)
+        return vjp(grad)[0]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return tuple(s - p[0] - p[1]
+                     for s, p in zip(input_shapes[0], self.paddings))
+
+
+class ReduceSumOp(Op):
+    def __init__(self, node_A, axes, keepdims=False, ctx=None):
+        super().__init__(ReduceSumOp, [node_A], ctx)
+        if isinstance(axes, int):
+            axes = [axes]
+        self.axes = list(axes)
+        if isinstance(keepdims, bool):
+            self.keepdims = [keepdims] * len(self.axes)
+        else:
+            self.keepdims = list(keepdims)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        if all(self.keepdims) or not any(self.keepdims):
+            return jnp.sum(x, axis=tuple(self.axes),
+                           keepdims=self.keepdims[0] if self.keepdims else False)
+        for i in range(len(self.axes))[::-1]:
+            x = jnp.sum(x, axis=self.axes[i], keepdims=self.keepdims[i])
+        return x
+
+    def gradient(self, output_grad):
+        add_axes = [self.axes[i] for i in range(len(self.axes))
+                    if not self.keepdims[i]]
+        node = broadcast_shape_grad_source_op(
+            output_grad, self.inputs[0], add_axes, ctx=self.raw_ctx)
+        return [node]
+
+    def infer_shape(self, input_shapes):
+        shape = list(input_shapes[0])
+        axes = [ax if ax >= 0 else ax + len(shape) for ax in self.axes]
+        out = []
+        for i, s in enumerate(shape):
+            if i in axes:
+                if self.keepdims[axes.index(i)]:
+                    out.append(1)
+            else:
+                out.append(s)
+        return tuple(out) if out else (1,)
+
+
+class ReduceMeanOp(Op):
+    def __init__(self, node_A, axes, keepdims=False, ctx=None):
+        super().__init__(ReduceMeanOp, [node_A], ctx)
+        if isinstance(axes, int):
+            axes = [axes]
+        self.axes = list(axes)
+        if isinstance(keepdims, bool):
+            self.keepdims = [keepdims] * len(self.axes)
+        else:
+            self.keepdims = list(keepdims)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        if all(self.keepdims) or not any(self.keepdims):
+            return jnp.mean(x, axis=tuple(self.axes),
+                            keepdims=self.keepdims[0] if self.keepdims else False)
+        for i in range(len(self.axes))[::-1]:
+            x = jnp.mean(x, axis=self.axes[i], keepdims=self.keepdims[i])
+        return x
+
+    def gradient(self, output_grad):
+        add_axes = [self.axes[i] for i in range(len(self.axes))
+                    if not self.keepdims[i]]
+        node = broadcast_shape_grad_source_op(
+            output_grad, self.inputs[0], add_axes, mean=True,
+            mean_axes=self.axes, ctx=self.raw_ctx)
+        return [node]
+
+    def infer_shape(self, input_shapes):
+        shape = list(input_shapes[0])
+        axes = [ax if ax >= 0 else ax + len(shape) for ax in self.axes]
+        out = []
+        for i, s in enumerate(shape):
+            if i in axes:
+                if self.keepdims[axes.index(i)]:
+                    out.append(1)
+            else:
+                out.append(s)
+        return tuple(out) if out else (1,)
+
+
+class ReduceSumAxisZeroOp(Op):
+    def __init__(self, node_A, ctx=None):
+        super().__init__(ReduceSumAxisZeroOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return jnp.sum(input_vals[0], axis=0)
+
+    def gradient(self, output_grad):
+        return [broadcastto_op(output_grad, self.inputs[0],
+                               ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        shape = tuple(input_shapes[0][1:])
+        return shape if shape else (1,)
+
+
+class BroadcastShapeGradSourceOp(Op):
+    """Adjoint of reduce_sum/mean: broadcast the grad back to the input's
+    shape (divided by the reduced size for mean). Shape taken from the
+    forward input node at infer time."""
+
+    def __init__(self, grad_node, target_node, add_axes, mean=False,
+                 mean_axes=None, ctx=None):
+        super().__init__(BroadcastShapeGradSourceOp, [grad_node], ctx)
+        self.target_node = target_node
+        self.add_axes = list(add_axes)
+        self.mean = mean
+        self.mean_axes = mean_axes
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        target_shape = self.target_shape
+        for ax in sorted(self.add_axes):
+            x = jnp.expand_dims(x, ax)
+        out = jnp.broadcast_to(x, target_shape)
+        if self.mean:
+            denom = 1
+            for ax in self.mean_axes:
+                denom *= target_shape[ax]
+            out = out / denom
+        return out
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        self.target_shape = tuple(self.target_node.inferred_shape)
+        return self.target_shape
+
+
+class UnbroadcastOp(Op):
+    """Adjoint of a broadcast: reduce the grad back to the target node's
+    shape. Optional ``sum_axes`` are reduced away first (inserted axes of
+    BroadcastShapeOp); the remainder follows numpy right-aligned rules —
+    extra leading dims and stretched size-1 dims are summed."""
+
+    def __init__(self, grad_node, target_node, sum_axes=(), ctx=None):
+        super().__init__(UnbroadcastOp, [grad_node], ctx)
+        self.target_node = target_node
+        self.sum_axes = tuple(sum_axes)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        if self.sum_axes:
+            x = jnp.sum(x, axis=self.sum_axes)
+        target_shape = self.target_shape
+        while x.ndim > len(target_shape):
+            x = jnp.sum(x, axis=0)
+        for i, s in enumerate(target_shape):
+            if s == 1 and x.shape[i] != 1:
+                x = jnp.sum(x, axis=i, keepdims=True)
+        return jnp.reshape(x, target_shape)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        self.target_shape = tuple(self.target_node.inferred_shape)
+        return self.target_shape
+
+
+class OnesLikeOp(Op):
+    def __init__(self, node_A, ctx=None):
+        super().__init__(OnesLikeOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return jnp.ones_like(input_vals[0])
+
+    def gradient(self, output_grad):
+        return [zeroslike_op(self.inputs[0], ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class ZerosLikeOp(Op):
+    def __init__(self, node_A, ctx=None):
+        super().__init__(ZerosLikeOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        return jnp.zeros_like(input_vals[0])
+
+    def gradient(self, output_grad):
+        return [zeroslike_op(self.inputs[0], ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def array_reshape_op(node, output_shape, ctx=None):
+    return ArrayReshapeOp(node, output_shape, ctx=ctx)
+
+
+def array_reshape_gradient_op(node, forward_node, ctx=None):
+    return ArrayReshapeGradientOp(node, forward_node, ctx=ctx)
+
+
+def broadcastto_op(node_A, node_B, ctx=None):
+    return BroadcastToOp(node_A, node_B, ctx=ctx)
+
+
+def broadcast_shape_op(node_A, shape, add_axes=(), ctx=None):
+    return BroadcastShapeOp(node_A, shape, add_axes=add_axes, ctx=ctx)
+
+
+def broadcast_shape_grad_source_op(grad_node, target_node, add_axes,
+                                   mean=False, mean_axes=None, ctx=None):
+    return BroadcastShapeGradSourceOp(grad_node, target_node, add_axes,
+                                      mean=mean, mean_axes=mean_axes, ctx=ctx)
+
+
+def unbroadcast_op(grad_node, target_node, sum_axes=(), ctx=None):
+    return UnbroadcastOp(grad_node, target_node, sum_axes=sum_axes, ctx=ctx)
+
+
+def concat_op(node_A, node_B, axis=0, ctx=None):
+    return ConcatOp(node_A, node_B, axis=axis, ctx=ctx)
+
+
+def concat_gradient_op(grad_node, input_node, axis, idx, ctx=None):
+    return ConcatGradientOp(grad_node, input_node, axis, idx, ctx=ctx)
+
+
+def concatenate_op(nodes, axis=0, ctx=None):
+    return ConcatenateOp(nodes, axis=axis, ctx=ctx)
+
+
+def split_op(node, axes, indices, splits, ctx=None):
+    return SplitOp(node, axes, indices, splits, ctx=ctx)
+
+
+def split_gradient_op(node, axes, indices, splits, forward_node=None,
+                      ctx=None):
+    return SplitGradientOp(node, axes, indices, splits,
+                           forward_node=forward_node, ctx=ctx)
+
+
+def slice_op(node, begin, size, ctx=None):
+    return SliceOp(node, begin, size, ctx=ctx)
+
+
+def slice_gradient_op(node, begin, size=None, forward_node=None, ctx=None):
+    return SliceGradientOp(node, begin, size, forward_node=forward_node,
+                           ctx=ctx)
+
+
+def transpose_op(node_A, perm=None, ctx=None):
+    return TransposeOp(node_A, perm=perm, ctx=ctx)
+
+
+def pad_op(node_A, paddings, mode="CONSTANT", constant_values=0, ctx=None):
+    return PadOp(node_A, paddings, mode=mode,
+                 constant_values=constant_values, ctx=ctx)
+
+
+def pad_gradient_op(node_A, paddings, mode="CONSTANT", ctx=None):
+    return PadGradientOp(node_A, paddings, mode=mode, ctx=ctx)
+
+
+def reduce_sum_op(node, axes, keepdims=False, ctx=None):
+    return ReduceSumOp(node, axes, keepdims=keepdims, ctx=ctx)
+
+
+def reduce_mean_op(node, axes, keepdims=False, ctx=None):
+    return ReduceMeanOp(node, axes, keepdims=keepdims, ctx=ctx)
+
+
+def reducesumaxiszero_op(node, ctx=None):
+    return ReduceSumAxisZeroOp(node, ctx=ctx)
+
+
+def oneslike_op(node, ctx=None):
+    return OnesLikeOp(node, ctx=ctx)
+
+
+def zeroslike_op(node, ctx=None):
+    return ZerosLikeOp(node, ctx=ctx)

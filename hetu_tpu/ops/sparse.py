@@ -1,0 +1,103 @@
+"""CSR sparse matmul ops.
+
+Reference parity: gpu_ops/CuSparse.py (cuSPARSE csrmv/csrmm kernels,
+src/ops/CuSparseCsrmm.cu). TPUs have no sparse unit, so CSR x dense lowers
+to gather + segment-sum — a pattern XLA vectorizes well — with the CSR
+arrays travelling as a pytree value produced by a sparse placeholder feed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op
+
+__all__ = ["csrmv_op", "csrmm_op"]
+
+
+def _csr_matmul(data, indptr, indices, dense, nrow):
+    """y[i] = sum_j A[i,j] * dense[j, :] for CSR A."""
+    nnz = data.shape[0]
+    # row id per nnz element from indptr (searchsorted is O(nnz log nrow))
+    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    gathered = dense[indices] * data[:, None]
+    return jax.ops.segment_sum(gathered, row_ids, num_segments=nrow)
+
+
+class CsrmmOp(Op):
+    """CSR (node_A, fed as sparse pytree) @ dense (node_B)."""
+
+    def __init__(self, node_A, node_B, trans_A=False, trans_B=False,
+                 ctx=None):
+        super().__init__(CsrmmOp, [node_A, node_B], ctx)
+        self.trans_A = trans_A
+        self.trans_B = trans_B
+
+    def compute(self, input_vals, ectx):
+        sp, dense = input_vals
+        data, indptr, indices, nrow, ncol = (
+            sp.data, sp.indptr, sp.indices, sp.nrow, sp.ncol)
+        if self.trans_B:
+            dense = dense.T
+        if self.trans_A:
+            # A^T @ B = scatter rows of B by column index
+            contrib = dense[jnp.searchsorted(
+                indptr, jnp.arange(data.shape[0]), side="right") - 1]
+            out = jax.ops.segment_sum(contrib * data[:, None],
+                                      indices, num_segments=ncol)
+            return out
+        return _csr_matmul(data, indptr, indices, dense, nrow)
+
+    def gradient(self, output_grad):
+        # grad wrt dense operand: A^T @ dy (transposed again if the forward
+        # consumed B transposed, so the adjoint matches B's layout)
+        grad_b = csrmm_op(self.inputs[0], output_grad,
+                          trans_A=not self.trans_A, ctx=self.raw_ctx)
+        if self.trans_B:
+            from .shape import transpose_op
+            grad_b = transpose_op(grad_b, (1, 0), ctx=self.raw_ctx)
+        return [None, grad_b]
+
+    def infer_shape(self, input_shapes):
+        a, b = input_shapes
+        m = a[1] if self.trans_A else a[0]
+        n = b[0] if self.trans_B else b[1]
+        return (m, n)
+
+
+class CsrmvOp(Op):
+    """CSR @ dense vector."""
+
+    def __init__(self, node_A, node_B, trans=False, ctx=None):
+        super().__init__(CsrmvOp, [node_A, node_B], ctx)
+        self.trans = trans
+
+    def compute(self, input_vals, ectx):
+        sp, vec = input_vals
+        data, indptr, indices, nrow, ncol = (
+            sp.data, sp.indptr, sp.indices, sp.nrow, sp.ncol)
+        nnz = data.shape[0]
+        row_ids = jnp.searchsorted(indptr, jnp.arange(nnz),
+                                   side="right") - 1
+        if self.trans:
+            return jax.ops.segment_sum(vec[row_ids] * data, indices,
+                                       num_segments=ncol)
+        return jax.ops.segment_sum(vec[indices] * data, row_ids,
+                                   num_segments=nrow)
+
+    def gradient(self, output_grad):
+        grad_b = csrmv_op(self.inputs[0], output_grad,
+                          trans=not self.trans, ctx=self.raw_ctx)
+        return [None, grad_b]
+
+    def infer_shape(self, input_shapes):
+        a = input_shapes[0]
+        return (a[1],) if self.trans else (a[0],)
+
+
+def csrmv_op(node_A, node_B, trans=False, ctx=None):
+    return CsrmvOp(node_A, node_B, trans=trans, ctx=ctx)
+
+
+def csrmm_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
+    return CsrmmOp(node_A, node_B, trans_A=trans_A, trans_B=trans_B, ctx=ctx)

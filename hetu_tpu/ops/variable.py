@@ -1,0 +1,95 @@
+"""Placeholders and variables (reference: gpu_ops/Variable.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.node import Op
+from .. import ndarray
+
+__all__ = ["PlaceholderOp", "Variable", "placeholder_op"]
+
+
+class PlaceholderOp(Op):
+    """A leaf node: either a trainable parameter (value/initializer given)
+    or a feed slot (reference Variable.py:19-108).
+
+    TP note: ``reshape_in_mp`` records the shard this device holds so the
+    executor materializes only the local slice of a model-parallel parameter
+    (reference Variable.py:82-108); in the TPU build the same information
+    lowers to a PartitionSpec and jax shards the parameter at device_put.
+    """
+
+    def __init__(self, name, value=None, initializer=None, trainable=True,
+                 dtype=np.float32, ctx=None):
+        super().__init__(PlaceholderOp, [], ctx)
+        self.name = name
+        self.is_embed = False
+        self.shape = None
+        if value is None and initializer is None:
+            trainable = False
+        elif value is not None:
+            assert initializer is None, \
+                "value already specified, initializer must be None"
+            if isinstance(value, ndarray.NDArray):
+                self.shape = value.shape
+            else:
+                value = np.asarray(value, dtype=dtype)
+                self.shape = value.shape
+        else:
+            self.shape = initializer.shape
+        self.tensor_value = value
+        self.initializer = initializer
+        self.trainable = trainable
+        self.dtype = dtype
+        self.reshaped = False
+        self.parts = None           # model-parallel shard coords
+        self.status = None          # NodeStatus assigned by planner
+
+    # ------------------------------------------------------------------
+    def compute(self, input_vals, ectx):
+        # Feeds and parameters are injected by the executor; reaching here
+        # means the node was neither fed nor initialized.
+        raise AssertionError(
+            f"placeholder {self.name} must be fed or initialized")
+
+    def gradient(self, output_grad):
+        return None
+
+    def infer_shape(self, input_shapes):
+        assert self.shape is not None, \
+            f"placeholder {self.name} shape comes from feed_shapes"
+        return self.shape
+
+    # ------------------------------------------------------------------
+    def reshape_in_mp(self, cur_part, parts):
+        """Record which shard of a model-parallel parameter this process
+        owns. Under SPMD jit we keep the full logical shape and let the
+        PartitionSpec place shards, so this only records metadata."""
+        self.reshaped = True
+        self.parts = (tuple(cur_part), tuple(parts))
+
+    def local_shape(self):
+        if not self.reshaped or self.parts is None:
+            return self.shape
+        _, parts = self.parts
+        return tuple(s // p for s, p in zip(self.shape, parts))
+
+    def initial_value(self, rng=None, seed=0):
+        """Materialize the initial value as a numpy/jax array."""
+        if self.tensor_value is not None:
+            if isinstance(self.tensor_value, ndarray.NDArray):
+                return self.tensor_value.asnumpy()
+            return np.asarray(self.tensor_value, dtype=self.dtype)
+        assert self.initializer is not None, \
+            f"placeholder {self.name} has no value"
+        return self.initializer.init_numpy(seed=seed + self.id)
+
+
+def Variable(name, value=None, initializer=None, trainable=True,
+             dtype=np.float32, ctx=None):
+    return placeholder_op(name, value, initializer, trainable, dtype, ctx)
+
+
+def placeholder_op(name, value=None, initializer=None, trainable=True,
+                   dtype=np.float32, ctx=None):
+    return PlaceholderOp(name, value, initializer, trainable, dtype, ctx)

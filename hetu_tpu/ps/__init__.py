@@ -1,0 +1,8 @@
+"""Parameter-server subsystem.
+
+TPU-native equivalent of the reference's ps-lite stack (C++ server over
+ZMQ/P3/IB-verbs). Here: a C++ host-side key-value server with typed PSF
+requests (dense/sparse push-pull, server-side optimizers, save/load) over
+TCP, a Python client bound via ctypes, and an embedding cache with bounded
+staleness. See ps/README.md for the protocol.
+"""

@@ -1,0 +1,26 @@
+"""PS client placeholder — fully implemented with the C++ server in the PS
+milestone; these entry points keep the executor importable before that."""
+from __future__ import annotations
+
+_default_client = None
+
+
+def get_default_client():
+    global _default_client
+    if _default_client is None:
+        raise RuntimeError(
+            "parameter-server mode requested but no PS is running; "
+            "start one with hetu_tpu.ps.server or the heturun launcher")
+    return _default_client
+
+
+def set_default_client(client):
+    global _default_client
+    _default_client = client
+
+
+def close_default_client():
+    global _default_client
+    if _default_client is not None:
+        _default_client.close()
+        _default_client = None

@@ -1,0 +1,19 @@
+"""Executor-side PS runtime — scheduling of host push/pull ops between
+compiled segments. Implemented with the C++ parameter server milestone."""
+from __future__ import annotations
+
+
+class PSRuntime:
+    def __init__(self, executor, config):
+        raise RuntimeError(
+            "PS runtime requested but the C++ parameter server is not "
+            "built yet; PS/Hybrid modes land with hetu_tpu/ps/native")
+
+    def run_step(self, subexecutor, feed_dict, convert):
+        raise NotImplementedError
+
+    def save(self, path):
+        raise NotImplementedError
+
+    def load(self, path):
+        raise NotImplementedError

@@ -1,0 +1,22 @@
+"""PS server process management — implemented with the C++ parameter
+server in the PS milestone; these stubs fail loudly until then."""
+from __future__ import annotations
+
+_NOT_READY = ("the C++ parameter server is not built yet; PS/Hybrid "
+              "communication modes land with hetu_tpu/ps/native")
+
+
+def ensure_scheduler():
+    raise RuntimeError(_NOT_READY)
+
+
+def shutdown_scheduler():
+    pass
+
+
+def ensure_server():
+    raise RuntimeError(_NOT_READY)
+
+
+def shutdown_server():
+    pass

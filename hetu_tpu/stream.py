@@ -1,0 +1,91 @@
+"""Streams and events, TPU-style.
+
+The reference manages five CUDA streams and per-node events
+(python/hetu/stream.py, executor.py:254-288). Under XLA every dispatched
+computation is already asynchronous and ordered by data dependency, so a
+"stream" here is a logical tag and an "event" is a handle whose ``sync()``
+is ``block_until_ready`` on the tagged value. ``PSEvent`` keeps the
+reference semantics of waiting on an in-flight parameter-server request
+(stream.py:67-81).
+"""
+from __future__ import annotations
+
+__all__ = ["Stream", "Event", "PSEvent", "CSEvent", "create_stream_handle",
+           "create_event_handle"]
+
+
+class Stream:
+    """Logical dispatch lane. XLA orders work by dependency; this object only
+    preserves the reference API (comp/h2d/d2h/nccl/p2p stream routing)."""
+
+    def __init__(self, name="comp"):
+        self.name = name
+        self._last = None
+
+    def record(self, value):
+        self._last = value
+        return value
+
+    def sync(self):
+        if self._last is not None and hasattr(self._last, "block_until_ready"):
+            self._last.block_until_ready()
+
+
+class Event:
+    """Completion marker for a node's output (reference stream.py:38)."""
+
+    def __init__(self, node_name=""):
+        self.node_name = node_name
+        self._value = None
+
+    def record(self, value=None, stream=None):
+        self._value = value
+
+    def update(self):
+        pass
+
+    def sync(self):
+        v = self._value
+        if v is None:
+            return
+        if hasattr(v, "block_until_ready"):
+            v.block_until_ready()
+        elif hasattr(v, "jax_array"):
+            v.jax_array.block_until_ready()
+
+
+class PSEvent(Event):
+    """Waits on an outstanding parameter-server request for this node
+    (reference stream.py:67: comm.Wait(node_id))."""
+
+    def __init__(self, comm, node_name=""):
+        super().__init__(node_name)
+        self.comm = comm
+
+    def update(self):
+        pass
+
+    def sync(self):
+        if self.comm is not None:
+            self.comm.wait(self.node_name)
+
+
+class CSEvent(Event):
+    """Waits on an embedding-cache timestamp (reference stream.py:85)."""
+
+    def __init__(self, cache, node_name=""):
+        super().__init__(node_name)
+        self.cache = cache
+        self.ts = -1
+
+    def sync(self):
+        if self.cache is not None and self.ts >= 0:
+            self.cache.wait(self.ts)
+
+
+def create_stream_handle(ctx=None, name="comp"):
+    return Stream(name)
+
+
+def create_event_handle(ctx=None, name=""):
+    return Event(name)

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU platform so sharding /
+multi-chip code paths run hermetically without TPUs (the fake-device
+strategy the reference lacks — SURVEY.md §4).
+
+Note: the environment may export JAX_PLATFORMS=axon (TPU tunnel); tests
+must override it, not setdefault.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# numeric tests compare against float64 numpy references; keep matmuls in
+# real float32 on the CPU backend (TPU bench runs use the default bf16 path)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

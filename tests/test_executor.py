@@ -1,0 +1,135 @@
+"""End-to-end executor tests: autodiff + optimizer convergence (mirrors the
+reference's examples/runner/parallel loss-trajectory strategy)."""
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+
+
+def _mlp_graph(bs=32, in_dim=20, hidden=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = ht.Variable("x", trainable=False)
+    y_ = ht.Variable("y", trainable=False)
+    w1 = ht.Variable("w1", value=rng.randn(in_dim, hidden).astype("f") * 0.1)
+    b1 = ht.Variable("b1", value=np.zeros(hidden, "f"))
+    w2 = ht.Variable("w2", value=rng.randn(hidden, classes).astype("f") * 0.1)
+    h = ht.relu_op(ht.matmul_op(x, w1) + ht.broadcastto_op(b1, ht.matmul_op(x, w1)))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    return x, y_, loss, logits
+
+
+def _toy_data(n=256, in_dim=20, classes=4, seed=1):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, in_dim).astype(np.float32)
+    w = rng.randn(in_dim, classes)
+    y = np.argmax(x @ w, axis=1)
+    return x, np.eye(classes, dtype=np.float32)[y]
+
+
+def test_mlp_converges_sgd():
+    x, y_, loss, logits = _mlp_graph()
+    opt = ht.optim.SGDOptimizer(learning_rate=0.5)
+    train_op = opt.minimize(loss)
+    exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    xs, ys = _toy_data()
+    losses = []
+    for epoch in range(30):
+        for i in range(0, len(xs), 32):
+            out = exe.run(feed_dict={x: xs[i:i + 32], y_: ys[i:i + 32]})
+            losses.append(float(out[0].asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_adam_and_momentum_run():
+    for opt in (ht.optim.AdamOptimizer(learning_rate=0.01),
+                ht.optim.MomentumOptimizer(learning_rate=0.1),
+                ht.optim.MomentumOptimizer(learning_rate=0.1, nesterov=True),
+                ht.optim.AdaGradOptimizer(learning_rate=0.1)):
+        x, y_, loss, _ = _mlp_graph(seed=3)
+        train_op = opt.minimize(loss)
+        exe = Executor([loss, train_op], ctx=ht.cpu(0))
+        xs, ys = _toy_data(128)
+        first = last = None
+        for _ in range(20):
+            out = exe.run(feed_dict={x: xs[:32], y_: ys[:32]})
+            val = float(out[0].asnumpy())
+            first = val if first is None else first
+            last = val
+        assert last < first, (opt.name, first, last)
+
+
+def test_gradients_numeric():
+    """Closed-form numpy check through a mixed op chain:
+    loss = mean(sigmoid(x @ w)); dL/dw = x^T @ (s(1-s))/N."""
+    rng = np.random.RandomState(5)
+    xv = rng.randn(4, 6).astype(np.float64)
+    wv = rng.randn(6, 3).astype(np.float64)
+    x = ht.Variable("x", value=xv.astype(np.float32))
+    w = ht.Variable("w", value=wv.astype(np.float32))
+    out = ht.reduce_mean_op(
+        ht.sigmoid_op(ht.matmul_op(x, w)), [0, 1])
+    grads = ht.gradients(out, [w, x])
+    exe = Executor([out] + grads, ctx=ht.cpu(0))
+    res = exe.run(feed_dict={})
+    gw, gx = res[1].asnumpy(), res[2].asnumpy()
+
+    s = 1 / (1 + np.exp(-(xv @ wv)))
+    dlogit = s * (1 - s) / s.size
+    np.testing.assert_allclose(gw, xv.T @ dlogit, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gx, dlogit @ wv.T, rtol=1e-4, atol=1e-6)
+
+
+def test_eval_subgraph_and_dataloader():
+    xs, ys = _toy_data(96)
+    x = ht.dataloader_op([[xs, 32, "train"], [xs, 32, "validate"]])
+    y_ = ht.dataloader_op([[ys, 32, "train"], [ys, 32, "validate"]])
+    w = ht.Variable("w", value=np.zeros((20, 4), "f"))
+    logits = ht.matmul_op(x, w)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=0.1)
+    train_op = opt.minimize(loss)
+    exe = Executor({"train": [loss, train_op], "validate": [loss]},
+                   ctx=ht.cpu(0))
+    assert exe.get_batch_num("train") == 3
+    tr0 = float(exe.run("train")[0].asnumpy())
+    for _ in range(8):
+        exe.run("train")
+    val = float(exe.run("validate")[0].asnumpy())
+    assert val < tr0
+
+
+def test_save_load(tmp_path):
+    x, y_, loss, _ = _mlp_graph(seed=7)
+    opt = ht.optim.AdamOptimizer(learning_rate=0.01)
+    train_op = opt.minimize(loss)
+    exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    xs, ys = _toy_data(64)
+    for _ in range(3):
+        exe.run(feed_dict={x: xs[:32], y_: ys[:32]})
+    exe.save(str(tmp_path))
+    ref = {k: np.asarray(v) for k, v in exe.params.items()}
+    for _ in range(3):
+        exe.run(feed_dict={x: xs[:32], y_: ys[:32]})
+    exe.load(str(tmp_path))
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(exe.params[k]), ref[k],
+                                   rtol=1e-6)
+
+
+def test_dropout_train_vs_eval():
+    xv = np.ones((64, 32), np.float32)
+    x = ht.Variable("x", value=xv)
+    drop = ht.dropout_op(x, 0.5)
+    s = ht.reduce_mean_op(drop, [0, 1])
+    # training executor (has optimizer over a dummy param)
+    w = ht.Variable("w", value=np.ones((1,), "f"))
+    loss = s + ht.reduce_mean_op(ht.mul_op(w, w), [0])
+    opt = ht.optim.SGDOptimizer(learning_rate=0.0)
+    train_op = opt.minimize(loss)
+    exe = Executor({"train": [s, train_op], "eval": [s]}, ctx=ht.cpu(0))
+    train_val = float(exe.run("train")[0].asnumpy())
+    eval_val = float(exe.run("eval")[0].asnumpy())
+    assert abs(eval_val - 1.0) < 1e-6          # identity at inference
+    assert abs(train_val - 1.0) < 0.2          # ~keep_prob-scaled mean
+    assert train_val != eval_val

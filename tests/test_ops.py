@@ -1,0 +1,194 @@
+"""Kernel-level op tests against numpy references (mirrors the reference's
+tests/test_gpu_op.py strategy)."""
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+
+
+def run_graph(eval_nodes, feeds=None):
+    exe = Executor(list(eval_nodes), ctx=ht.cpu(0))
+    return [r.asnumpy() if r is not None else None
+            for r in exe.run(feed_dict=feeds or {})]
+
+
+def rand(*shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(np.float32)
+
+
+def test_add_mul_div():
+    a = ht.Variable("a", value=rand(3, 4, seed=1))
+    b = ht.Variable("b", value=rand(3, 4, seed=2))
+    (s, m, d, c) = run_graph([
+        ht.add_op(a, b), ht.mul_op(a, b), ht.div_op(a, b),
+        ht.addbyconst_op(a, 5.0)])
+    av, bv = rand(3, 4, seed=1), rand(3, 4, seed=2)
+    np.testing.assert_allclose(s, av + bv, rtol=1e-5)
+    np.testing.assert_allclose(m, av * bv, rtol=1e-5)
+    np.testing.assert_allclose(d, av / bv, rtol=1e-4)
+    np.testing.assert_allclose(c, av + 5, rtol=1e-5)
+
+
+def test_matmul_all_transposes():
+    av, bv = rand(4, 5, seed=3), rand(5, 6, seed=4)
+    for tA in (False, True):
+        for tB in (False, True):
+            A = ht.Variable("A", value=av.T if tA else av)
+            B = ht.Variable("B", value=bv.T if tB else bv)
+            (out,) = run_graph([ht.matmul_op(A, B, tA, tB)])
+            np.testing.assert_allclose(out, av @ bv, rtol=1e-4)
+
+
+def test_batch_matmul():
+    av, bv = rand(2, 4, 5, seed=5), rand(2, 5, 3, seed=6)
+    A = ht.Variable("A", value=av)
+    B = ht.Variable("B", value=bv)
+    (out,) = run_graph([ht.batch_matmul_op(A, B)])
+    np.testing.assert_allclose(out, av @ bv, rtol=1e-4)
+
+
+def test_activations():
+    xv = rand(3, 7, seed=7)
+    x = ht.Variable("x", value=xv)
+    relu, lrelu, sig, tanh = run_graph([
+        ht.relu_op(x), ht.leaky_relu_op(x, 0.1), ht.sigmoid_op(x),
+        ht.tanh_op(x)])
+    np.testing.assert_allclose(relu, np.maximum(xv, 0), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(lrelu, np.where(xv > 0, xv, 0.1 * xv),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sig, 1 / (1 + np.exp(-xv)), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(tanh, np.tanh(xv), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_and_ce():
+    xv = rand(5, 9, seed=8)
+    yv = np.eye(9, dtype=np.float32)[np.arange(5)]
+    x = ht.Variable("x", value=xv)
+    y = ht.Variable("y", value=yv)
+    sm, ce = run_graph([ht.softmax_op(x), ht.softmaxcrossentropy_op(x, y)])
+    ex = np.exp(xv - xv.max(-1, keepdims=True))
+    ref_sm = ex / ex.sum(-1, keepdims=True)
+    np.testing.assert_allclose(sm, ref_sm, rtol=1e-5)
+    ref_ce = -np.sum(yv * np.log(ref_sm + 1e-12), axis=-1)
+    np.testing.assert_allclose(ce, ref_ce, rtol=1e-4)
+
+
+def test_reduce_and_broadcast():
+    xv = rand(4, 6, seed=9)
+    x = ht.Variable("x", value=xv)
+    b = ht.Variable("b", value=rand(6, seed=10))
+    rs, rm, rz, bc = run_graph([
+        ht.reduce_sum_op(x, [0]), ht.reduce_mean_op(x, [1]),
+        ht.reducesumaxiszero_op(x), ht.broadcastto_op(b, x)])
+    np.testing.assert_allclose(rs, xv.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(rm, xv.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(rz, xv.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(bc, np.broadcast_to(rand(6, seed=10), (4, 6)),
+                               rtol=1e-5)
+
+
+def test_shape_ops():
+    xv = rand(4, 6, seed=11)
+    x = ht.Variable("x", value=xv)
+    rsh, tr, sl, cc = run_graph([
+        ht.array_reshape_op(x, (2, 12)),
+        ht.transpose_op(x, (1, 0)),
+        ht.slice_op(x, (1, 2), (2, 3)),
+        ht.concat_op(x, x, axis=1)])
+    np.testing.assert_allclose(rsh, xv.reshape(2, 12))
+    np.testing.assert_allclose(tr, xv.T)
+    np.testing.assert_allclose(sl, xv[1:3, 2:5])
+    np.testing.assert_allclose(cc, np.concatenate([xv, xv], axis=1))
+
+
+def test_split_pad_onehot_where():
+    xv = rand(4, 6, seed=12)
+    x = ht.Variable("x", value=xv)
+    iv = np.array([0, 2, 1], dtype=np.float32)
+    i = ht.Variable("i", value=iv)
+    sp, pd, oh = run_graph([
+        ht.split_op(x, [1], [1], [2]),
+        ht.pad_op(x, [(1, 1), (0, 2)]),
+        ht.one_hot_op(i, 4)])
+    np.testing.assert_allclose(sp, xv[:, 3:])
+    np.testing.assert_allclose(pd, np.pad(xv, [(1, 1), (0, 2)]))
+    np.testing.assert_allclose(oh, np.eye(4, dtype=np.float32)[[0, 2, 1]])
+
+
+def test_conv2d_and_pool():
+    xv = rand(2, 3, 8, 8, seed=13)
+    wv = rand(4, 3, 3, 3, seed=14)
+    x = ht.Variable("x", value=xv)
+    w = ht.Variable("w", value=wv)
+    conv, mp, ap = run_graph([
+        ht.conv2d_op(x, w, padding=1, stride=1),
+        ht.max_pool2d_op(x, 2, 2, 0, 2),
+        ht.avg_pool2d_op(x, 2, 2, 0, 2)])
+    # numpy reference conv
+    xp = np.pad(xv, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    ref = np.zeros((2, 4, 8, 8), dtype=np.float32)
+    for n in range(2):
+        for o in range(4):
+            for yy in range(8):
+                for xx in range(8):
+                    ref[n, o, yy, xx] = np.sum(
+                        xp[n, :, yy:yy + 3, xx:xx + 3] * wv[o])
+    np.testing.assert_allclose(conv, ref, rtol=1e-3, atol=1e-4)
+    ref_mp = xv.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    ref_ap = xv.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(mp, ref_mp, rtol=1e-5)
+    np.testing.assert_allclose(ap, ref_ap, rtol=1e-5)
+
+
+def test_layernorm():
+    xv = rand(4, 10, seed=15)
+    x = ht.Variable("x", value=xv)
+    scale = ht.Variable("s", value=np.ones(10, np.float32))
+    bias = ht.Variable("b", value=np.zeros(10, np.float32))
+    (out,) = run_graph([ht.layer_normalization_op(x, scale, bias, eps=1e-5)])
+    mean = xv.mean(-1, keepdims=True)
+    var = xv.var(-1, keepdims=True)
+    np.testing.assert_allclose(out, (xv - mean) / np.sqrt(var + 1e-5),
+                               rtol=1e-4)
+
+
+def test_embedding_lookup():
+    table = rand(20, 8, seed=16)
+    idx = np.array([[1, 5], [3, 19]], dtype=np.float32)
+    emb = ht.Variable("emb", value=table)
+    i = ht.Variable("i", value=idx)
+    (out,) = run_graph([ht.embedding_lookup_op(emb, i)])
+    np.testing.assert_allclose(out, table[idx.astype(int)], rtol=1e-5)
+
+
+def test_csrmm():
+    import scipy.sparse as sp
+    rng = np.random.RandomState(17)
+    dense_a = (rng.rand(6, 5) < 0.4) * rng.randn(6, 5)
+    bv = rand(5, 3, seed=18)
+    spa = ht.sparse_array(
+        *_coo(dense_a), shape=(6, 5))
+    a = ht.Variable("a", value=None, trainable=False)
+    b = ht.Variable("b", value=bv)
+    out = run_graph([ht.csrmm_op(a, b)], feeds={a: spa})[0]
+    np.testing.assert_allclose(out, dense_a.astype(np.float32) @ bv,
+                               rtol=1e-4, atol=1e-5)
+
+
+def _coo(dense):
+    rows, cols = np.nonzero(dense)
+    return dense[rows, cols].astype(np.float32), (rows, cols)
+
+
+def test_instance_norm_and_bn_shapes():
+    xv = rand(2, 3, 4, 4, seed=19)
+    x = ht.Variable("x", value=xv)
+    (out,) = run_graph([ht.instance_normalization2d_op(x, eps=1e-5)])
+    mean = xv.mean(axis=(2, 3), keepdims=True)
+    var = xv.var(axis=(2, 3), keepdims=True)
+    np.testing.assert_allclose(out, (xv - mean) / np.sqrt(var + 1e-5),
+                               rtol=1e-3)
