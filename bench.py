@@ -1,0 +1,55 @@
+"""Headline benchmark: BERT-base MLM training throughput (tokens/sec/chip).
+
+Matches BASELINE.json's "BERT-base tokens/sec/chip (AllReduce)" config —
+the reference measures per-step wall time in
+examples/nlp/bert/train_hetu_bert.py:79-81. vs_baseline compares against
+a Hetu-GPU-class reference throughput for BERT-base at seq 128 (V100-era
+hardware the reference targeted, ~4200 tokens/s/GPU); >1.0 beats it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Hetu-GPU BERT-base seq-128 per-GPU throughput class (see BASELINE.md —
+# the repo publishes claims, not numbers; this anchors vs_baseline).
+BASELINE_TOKENS_PER_SEC = 4200.0
+
+
+def main():
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    from __graft_entry__ import _bert_graph, _feed_values
+
+    vocab, seq_len, batch = 30522, 128, 32
+    loss, feed_nodes = _bert_graph(vocab=vocab, seq_len=seq_len)
+    opt = ht.optim.AdamOptimizer(learning_rate=1e-4)
+    train_op = opt.minimize(loss)
+    exe = Executor([loss, train_op])
+    feeds = _feed_values(feed_nodes, batch, seq_len, vocab)
+
+    # warmup (compile) + steady-state timing
+    for _ in range(3):
+        exe.run(feed_dict=feeds)
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(feed_dict=feeds)
+    out[0].asnumpy()                      # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq_len / dt
+    print(json.dumps({
+        "metric": "bert_base_mlm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

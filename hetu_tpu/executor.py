@@ -279,8 +279,30 @@ class SubExecutor:
                        else opt_state)
             return outputs, new_params, new_state, new_opt
 
-        donate = (0, 2) if training else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        return step_fn
+
+    def _compile_step(self):
+        donate = (0, 2) if self.training else ()
+        return jax.jit(self._build_step(), donate_argnums=donate)
+
+    def trace_args(self, executor, feed_map):
+        """The argument tuple ``step_fn`` expects for this feed map —
+        used by compile-check harnesses (__graft_entry__) and run()."""
+        lr = jnp.float32(0.0)
+        for opt in self.optimizer_ops:
+            lr = jnp.float32(opt.optimizer.learning_rate)
+        feeds = [feed_map[n] for n in
+                 (list(self.feed_nodes) + list(self.dataloader_ops))]
+        return (executor.params, executor.state, executor.opt_state, feeds,
+                lr, jnp.int32(self.step_count),
+                executor.rngkey(self.step_count))
+
+    def prepare(self, executor, feed_map):
+        """Shape-infer + state-init for a feed map without compiling;
+        returns the raw (unjitted) step function."""
+        self._infer_shapes(feed_map)
+        self._ensure_state(executor)
+        return self._build_step()
 
     # ------------------------------------------------------------------
     def run(self, executor, feed_dict=None, convert_to_numpy_ret_vals=False):
@@ -301,17 +323,11 @@ class SubExecutor:
         if key not in self.compiled:
             self._infer_shapes(feed_map)
             self._ensure_state(executor)
-            self.compiled[key] = self._build_step()
+            self.compiled[key] = self._compile_step()
         fn = self.compiled[key]
 
-        lr = jnp.float32(0.0)
-        for opt in self.optimizer_ops:
-            lr = jnp.float32(opt.optimizer.learning_rate)
-        feeds = [feed_map[n] for n in
-                 (list(self.feed_nodes) + list(self.dataloader_ops))]
         outputs, new_params, new_state, new_opt = fn(
-            executor.params, executor.state, executor.opt_state, feeds,
-            lr, jnp.int32(self.step_count), executor.rngkey(self.step_count))
+            *self.trace_args(executor, feed_map))
         if self.training:
             executor.params = new_params
             executor.state = new_state

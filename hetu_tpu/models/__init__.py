@@ -1,0 +1,15 @@
+"""Model zoo — graph-building functions with the reference's interfaces.
+
+Reference parity: examples/cnn/models/ (LogReg, MLP, CNN, LeNet, AlexNet,
+VGG, ResNet, RNN, LSTM), examples/nlp/bert/hetu_bert.py (BERT family),
+examples/ctr/models/ (WDL, DeepFM, DCN, DC), examples/gnn/gnn_model (GCN,
+GraphSAGE). Each builder takes placeholder nodes and returns (loss, y)
+graph nodes, exactly like the reference's ``model(x, y_)`` convention.
+"""
+from .cnn import (logreg, mlp, cnn_3_layers, lenet, alexnet, vgg16, vgg19,
+                  resnet18, resnet34, rnn, lstm)
+from .bert import (BertConfig, BertModel, BertForPreTraining,
+                   BertForSequenceClassification, BertForMaskedLM)
+from .ctr import (wdl_criteo, wdl_adult, deepfm_criteo, dcn_criteo,
+                  dc_criteo)
+from .gnn import gcn_layer, gcn, graphsage

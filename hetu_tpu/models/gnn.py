@@ -1,0 +1,116 @@
+"""Graph neural network layers and models (reference parity:
+examples/gnn/gnn_model/{layer,model}.py).
+
+``GCN``/``SageConv`` mirror the reference layer classes; ``gcn``/
+``graphsage`` build a 2-layer node-classification model. The normalized
+adjacency is a CSR sparse feed (``ht.Variable`` fed with an
+``ND_Sparse_Array``) and message passing lowers to the gather/segment-sum
+csrmm op — the TPU replacement for cuSPARSE csrmm (src/ops/CuSparseCsrmm.cu).
+"""
+from __future__ import annotations
+
+from .. import initializers as init
+from ..ops import (broadcastto_op, concat_op, csrmm_op, dropout_op,
+                   matmul_op, mul_op, reduce_mean_op, relu_op,
+                   softmaxcrossentropy_op)
+from ..ops.variable import Variable
+
+__all__ = ["GCN", "SageConv", "gcn_layer", "gcn", "graphsage"]
+
+
+class GCN:
+    """Graph convolution: x -> norm_adj @ (x W + b) (reference layer.py:5-35)."""
+
+    def __init__(self, in_features, out_features, norm_adj, activation=None,
+                 dropout=0, name="GCN", custom_init=None):
+        if custom_init is not None:
+            self.weight = Variable(name + "_Weight", value=custom_init[0])
+            self.bias = Variable(name + "_Bias", value=custom_init[1])
+        else:
+            self.weight = init.xavier_uniform(
+                shape=(in_features, out_features), name=name + "_Weight")
+            self.bias = init.zeros(shape=(out_features,),
+                                   name=name + "_Bias")
+        self.mp = norm_adj
+        self.activation = activation
+        self.dropout = dropout
+        self.output_width = out_features
+
+    def __call__(self, x):
+        if self.dropout > 0:
+            x = dropout_op(x, 1 - self.dropout)
+        x = matmul_op(x, self.weight)
+        msg = x + broadcastto_op(self.bias, x)
+        x = csrmm_op(self.mp, msg)
+        if self.activation == "relu":
+            x = relu_op(x)
+        elif self.activation is not None:
+            raise NotImplementedError(self.activation)
+        return x
+
+
+class SageConv:
+    """GraphSAGE conv: concat(adj @ x W + b, x W2) (reference layer.py:38-69)."""
+
+    def __init__(self, in_features, out_features, norm_adj, activation=None,
+                 dropout=0, name="Sage", custom_init=None):
+        self.weight = init.xavier_uniform(shape=(in_features, out_features),
+                                          name=name + "_Weight")
+        self.bias = init.zeros(shape=(out_features,), name=name + "_Bias")
+        self.weight2 = init.xavier_uniform(
+            shape=(in_features, out_features), name=name + "_Weight2")
+        self.mp = norm_adj
+        self.activation = activation
+        self.dropout = dropout
+        self.output_width = 2 * out_features
+
+    def __call__(self, x):
+        feat = x
+        if self.dropout > 0:
+            x = dropout_op(x, 1 - self.dropout)
+        x = csrmm_op(self.mp, x)
+        x = matmul_op(x, self.weight)
+        x = x + broadcastto_op(self.bias, x)
+        if self.activation == "relu":
+            x = relu_op(x)
+        elif self.activation is not None:
+            raise NotImplementedError(self.activation)
+        return concat_op(x, matmul_op(feat, self.weight2), axis=1)
+
+
+def gcn_layer(x, in_features, out_features, norm_adj, activation=None,
+              name="GCN"):
+    return GCN(in_features, out_features, norm_adj, activation=activation,
+               name=name)(x)
+
+
+def _node_classifier(feat, y_, mask_, norm_adj, feature_dim,
+                     hidden_layer_size, num_classes, lr, arch):
+    """2-layer dense model (reference model.py:42-63): masked CE loss."""
+    from ..optimizer import SGDOptimizer
+    l1 = arch(feature_dim, hidden_layer_size, norm_adj, activation="relu",
+              name="gnn_l1")
+    l2 = arch(l1.output_width, hidden_layer_size, norm_adj,
+              activation="relu", name="gnn_l2")
+    classifier = init.xavier_uniform(shape=(l2.output_width, num_classes),
+                                     name="gnn_classifier")
+    x = l1(feat)
+    x = l2(x)
+    y = matmul_op(x, classifier)
+    loss = softmaxcrossentropy_op(y, y_)
+    train_loss = reduce_mean_op(mul_op(loss, mask_), [0])
+    opt = SGDOptimizer(lr)
+    train_op = opt.minimize(train_loss)
+    return loss, y, train_op
+
+
+def gcn(feat, y_, mask_, norm_adj, feature_dim, hidden_layer_size,
+        num_classes, lr=0.1):
+    return _node_classifier(feat, y_, mask_, norm_adj, feature_dim,
+                            hidden_layer_size, num_classes, lr, GCN)
+
+
+def graphsage(feat, y_, mask_, norm_adj, feature_dim, hidden_layer_size,
+              num_classes, lr=0.1):
+    return _node_classifier(feat, y_, mask_, norm_adj, feature_dim,
+                            hidden_layer_size, num_classes, lr, SageConv)

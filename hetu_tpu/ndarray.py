@@ -315,7 +315,9 @@ class CSRValue:
 
     @classmethod
     def from_sparse_array(cls, sp: "ND_Sparse_Array"):
-        return cls(sp.data.jax_array, sp.row.jax_array, sp.col.jax_array,
+        def as_jax(v):
+            return v.jax_array if isinstance(v, NDArray) else jnp.asarray(v)
+        return cls(as_jax(sp.data), as_jax(sp.row), as_jax(sp.col),
                    sp.nrow, sp.ncol)
 
 

@@ -1,0 +1,124 @@
+"""Fused multi-head attention op.
+
+No reference equivalent — the reference composes attention from
+batch_matmul + softmax (examples/nlp/bert/hetu_bert.py:191-227) and has no
+long-context support (SURVEY.md §5). This op is the single fusion point the
+TPU build hangs its fast paths on:
+
+  * default: one composed-XLA computation (fused softmax(QK^T)V) — XLA
+    already keeps this on-chip for moderate S,
+  * ``hetu_tpu.ops.pallas_attention``: a Pallas flash-attention kernel
+    (blocked online-softmax, never materializes the S×S score matrix in
+    HBM) selected automatically on TPU backends,
+  * ring-attention context parallelism wraps this op per KV block
+    (parallel/ring.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op
+
+__all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference"]
+
+
+def attention_reference(q, k, v, mask, sm_scale):
+    """softmax(q k^T * scale + mask) v — [B, H, S, D] layout."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if mask is not None:
+        scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _use_pallas():
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+        from . import pallas_attention       # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+class FlashAttentionOp(Op):
+    """Fused attention over [B, H, S, D] q/k/v with an additive mask of
+    shape [B, 1, 1, S] (or None)."""
+
+    def __init__(self, q, k, v, mask=None, sm_scale=1.0, causal=False,
+                 ctx=None):
+        inputs = [q, k, v] + ([mask] if mask is not None else [])
+        super().__init__(FlashAttentionOp, inputs, ctx)
+        self.has_mask = mask is not None
+        self.sm_scale = sm_scale
+        self.causal = causal
+
+    def compute(self, input_vals, ectx):
+        q, k, v = input_vals[:3]
+        mask = input_vals[3] if self.has_mask else None
+        if self.causal:
+            s = q.shape[-2]
+            cmask = jnp.where(
+                jnp.tril(jnp.ones((s, s), bool)), 0.0, -1e9)[None, None]
+            mask = cmask if mask is None else mask + cmask
+        if _use_pallas():
+            from .pallas_attention import flash_attention
+            return flash_attention(q, k, v, mask, self.sm_scale)
+        return attention_reference(q, k, v, mask, self.sm_scale)
+
+    def gradient(self, output_grad):
+        grads = [
+            _FlashAttentionGradOp(self, output_grad, i, ctx=self.raw_ctx)
+            for i in range(3)]
+        if self.has_mask:
+            grads.append(None)
+        return grads
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class _FlashAttentionGradOp(Op):
+    """dq/dk/dv via jax.vjp over the fused forward — one op per operand so
+    the graph stays an adjoint DAG (the reference packs/unpacks gradients
+    the same way for BN/LN)."""
+
+    def __init__(self, forward_op, output_grad, which, ctx=None):
+        super().__init__(_FlashAttentionGradOp,
+                         list(forward_op.inputs) + [output_grad], ctx)
+        self.forward_op = forward_op
+        self.which = which
+
+    def compute(self, input_vals, ectx):
+        fwd = self.forward_op
+        nin = 4 if fwd.has_mask else 3
+        q, k, v = input_vals[:3]
+        mask = input_vals[3] if fwd.has_mask else None
+        dy = input_vals[nin]
+
+        cache_key = ("flashattn_vjp", fwd.id)
+        if cache_key not in ectx.cache:
+            def f(q_, k_, v_):
+                m = mask
+                if fwd.causal:
+                    s = q_.shape[-2]
+                    cmask = jnp.where(
+                        jnp.tril(jnp.ones((s, s), bool)), 0.0,
+                        -1e9)[None, None]
+                    m = cmask if m is None else m + cmask
+                return attention_reference(q_, k_, v_, m, fwd.sm_scale)
+            _, vjp = jax.vjp(f, q, k, v)
+            ectx.cache[cache_key] = vjp(dy)
+        return ectx.cache[cache_key][self.which]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[self.which]
+
+
+def flash_attention_op(q, k, v, mask=None, sm_scale=1.0, causal=False,
+                       ctx=None):
+    return FlashAttentionOp(q, k, v, mask, sm_scale, causal, ctx=ctx)

@@ -97,6 +97,7 @@ class BatchNormalizationGradientOp(Op):
 
     def compute(self, input_vals, ectx):
         dy, x, scale = input_vals
+        scale = scale.reshape(-1)       # accept (C,) or (1, C, 1, 1) params
         axes = (0, 2, 3)
         n = x.shape[0] * x.shape[2] * x.shape[3]
         mean = jnp.mean(x, axis=axes)

@@ -1,0 +1,13 @@
+"""Parallelism subsystems.
+
+TPU-native replacements for the reference's planner/executors
+(context.py:256-726, executor.py:457-1337). Submodules land milestone by
+milestone:
+
+  * ``planner``  — NodeStatus propagation from ``ht.dispatch`` markers,
+                   lowered to PartitionSpec sharding constraints (TP).
+  * ``mesh``     — device-mesh construction helpers (dp/tp/pp/sp axes).
+  * ``pipeline`` — GPipe and PipeDream(1F1B) pipeline executors.
+  * ``ring``     — ring attention / sequence parallelism (new capability,
+                   absent in the reference — SURVEY.md §5).
+"""

@@ -15,6 +15,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+# the axon TPU-tunnel plugin (sitecustomize) forces jax_platforms="axon,cpu"
+# programmatically; env vars alone don't stick — override via config.
+jax.config.update("jax_platforms", "cpu")
+
 # numeric tests compare against float64 numpy references; keep matmuls in
 # real float32 on the CPU backend (TPU bench runs use the default bf16 path)
 jax.config.update("jax_default_matmul_precision", "highest")
