@@ -1,0 +1,88 @@
+"""CacheSparseTable — Python facade over the C++ embedding cache
+(reference parity: python/hetu/cstable.py:19-211 over the hetu_cache
+pybind module).
+
+Policies: LRU / LFU / LFUOpt. Perf counters mirror the reference's
+miss-rate helpers (cstable.py:163-187).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .ps.native_lib import as_f32, as_i64, fptr, get_lib, lptr
+
+__all__ = ["CacheSparseTable"]
+
+_POLICIES = {"LRU": 0, "LFU": 1, "LFUOpt": 2}
+
+
+def _bind(lib):
+    if getattr(lib, "_cache_bound", False):
+        return lib
+    i64 = ctypes.c_int64
+    lib.CacheCreate.argtypes = [ctypes.c_int, i64, i64, ctypes.c_int, i64,
+                                i64]
+    lib.CacheCreate.restype = ctypes.c_int
+    lib.CacheDestroy.argtypes = [ctypes.c_int]
+    lib.CacheLookup.argtypes = [ctypes.c_int,
+                                ctypes.POINTER(i64), i64,
+                                ctypes.POINTER(ctypes.c_float)]
+    lib.CacheUpdate.argtypes = [ctypes.c_int, ctypes.POINTER(i64),
+                                ctypes.POINTER(ctypes.c_float), i64]
+    lib.CacheFlush.argtypes = [ctypes.c_int]
+    lib.CachePerf.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.CachePerf.restype = ctypes.c_uint64
+    lib._cache_bound = True
+    return lib
+
+
+class CacheSparseTable:
+    """Bounded-staleness cached view of one PS embedding table."""
+
+    def __init__(self, node_id, length, width, limit, policy="LFUOpt",
+                 pull_bound=100, push_bound=100):
+        assert policy in _POLICIES, f"unknown cache policy {policy}"
+        self.node_id = node_id
+        self.length = length
+        self.width = int(width)
+        self.limit = int(limit)
+        self.policy = policy
+        self.lib = _bind(get_lib())
+        self.handle = self.lib.CacheCreate(
+            node_id, self.limit, self.width, _POLICIES[policy],
+            int(pull_bound), int(push_bound))
+
+    def embedding_lookup(self, keys):
+        idx = as_i64(keys).ravel()
+        out = np.empty((idx.size, self.width), np.float32)
+        self.lib.CacheLookup(self.handle, lptr(idx), idx.size, fptr(out))
+        return out.reshape(tuple(np.shape(keys)) + (self.width,))
+
+    def embedding_update(self, keys, grads):
+        idx = as_i64(keys).ravel()
+        g = as_f32(grads).reshape(idx.size, self.width)
+        self.lib.CacheUpdate(self.handle, lptr(idx), fptr(g), idx.size)
+
+    def flush(self):
+        self.lib.CacheFlush(self.handle)
+
+    # -- perf counters (reference cstable.py:126-187) -------------------
+    @property
+    def perf(self):
+        names = ["hits", "misses", "evicts", "size", "pushed_rows",
+                 "pulled_rows"]
+        return {n: int(self.lib.CachePerf(self.handle, i))
+                for i, n in enumerate(names)}
+
+    def miss_rate(self):
+        p = self.perf
+        total = p["hits"] + p["misses"]
+        return p["misses"] / total if total else 0.0
+
+    def __del__(self):
+        try:
+            self.lib.CacheDestroy(self.handle)
+        except Exception:
+            pass

@@ -71,7 +71,8 @@ class HetuConfig:
                  use_sparse_pull=True, cstable_policy=None, bsp=False,
                  prefetch=True, enable_lazy=False, cache_bound=100,
                  log_path=None, gpipe=False, pipedream=False,
-                 dynamic_memory=False, mesh=None, dtype=None):
+                 dynamic_memory=False, mesh=None, dtype=None,
+                 num_microbatches=None):
         self.eval_node_list = eval_node_list
         self.train_name = train_name
         self.val_name = val_name
@@ -86,6 +87,7 @@ class HetuConfig:
         self.log_path = log_path
         self.use_gpipe = gpipe
         self.use_pipedream = pipedream
+        self.num_microbatches = num_microbatches
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype
         self.ps_comm = None
@@ -116,6 +118,12 @@ class HetuConfig:
 
         # hook pass: splice comm ops (reference executor.py:314)
         topo_sort_with_hook(eval_node_list, self)
+
+        # -- TP planner (reference assign_context_by_traverse_nodes) ----
+        self.node_spec = {}
+        self.model_axes = {}
+        from .parallel.planner import assign_states
+        assign_states(eval_node_list, self)
         if self.comm_mode in ("PS", "Hybrid") or self.ps_nodes:
             from .ps.client import get_default_client
             self.ps_comm = get_default_client()
@@ -135,7 +143,7 @@ class HetuConfig:
     # -- sharding helpers ---------------------------------------------------
     def data_sharding(self, ndim):
         """Batch-dim sharding for feeds under data parallelism."""
-        if self.mesh is None:
+        if self.mesh is None or "dp" not in self.mesh.axis_names:
             return None
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh,
@@ -149,11 +157,7 @@ class HetuConfig:
 
     def spec_for(self, node):
         """PartitionSpec for a node assigned by the TP planner."""
-        status = self.node_status.get(node)
-        if status is None:
-            return None
-        axes_map = getattr(node, "mesh_axes", None)
-        return status.to_partition_spec(axes_map)
+        return self.node_spec.get(node)
 
 
 class SubExecutor:
@@ -187,8 +191,23 @@ class SubExecutor:
         self.stateful_ops = [n for n in self.topo_order
                              if getattr(n, "stateful", False)]
         self.ps_ops = [n for n in self.topo_order
-                       if isinstance(n, (ParameterServerCommunicateOp,
-                                         ParameterServerSparsePullOp))]
+                       if isinstance(n, ParameterServerCommunicateOp)]
+        self.ps_pull_ops = [n for n in self.topo_order
+                            if isinstance(n, ParameterServerSparsePullOp)]
+        # PS-managed params are identified session-wide (config.ps_nodes)
+        # so eval/inference subgraphs that share a PS embedding also skip
+        # materialization and route lookups through the PS runtime.
+        ps_params = {op.parameter for op in config.ps_nodes
+                     if hasattr(op, "parameter")}
+        from .ops.embedding import EmbeddingLookUp
+        self.ps_lookups = [n for n in self.topo_order
+                           if isinstance(n, EmbeddingLookUp)
+                           and n.inputs[0] in ps_params]
+        # PS-managed embedding tables never materialize on the worker;
+        # their lookups are fed from SparsePull (reference prefetch
+        # ps_map, executor.py:1634-1636)
+        self.param_nodes = [n for n in self.param_nodes
+                            if not (n in ps_params and n.is_embed)]
         self.compiled = {}
         self.step_count = 0
         self.batch_num = None
@@ -199,9 +218,13 @@ class SubExecutor:
                     else min(self.batch_num, bn)
 
     # ------------------------------------------------------------------
+    def _feed_order(self):
+        return (list(self.feed_nodes) + list(self.dataloader_ops)
+                + list(self.ps_lookups) + list(self.ps_pull_ops))
+
     def _shape_key(self, feed_map):
         key = []
-        for node in self.feed_nodes + self.dataloader_ops:
+        for node in self._feed_order():
             v = feed_map[node]
             if isinstance(v, ndarray.CSRValue):
                 key.append(("csr", v.data.shape, v.nrow, v.ncol))
@@ -244,11 +267,13 @@ class SubExecutor:
         topo = self.topo_order
         config = self.config
         training = self.training
-        feed_order = list(self.feed_nodes) + list(self.dataloader_ops)
+        feed_order = self._feed_order()
         param_order = list(self.param_nodes)
         state_order = list(self.stateful_ops)
         eval_nodes = self.eval_node_list
         optimizer_set = set(self.optimizer_ops)
+        ps_ops = list(self.ps_ops)
+        host_ops = set(ps_ops)      # sparse-pull ops arrive as feeds
 
         def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
             ectx = ExecContext(training=training, base_rng=rng,
@@ -267,6 +292,13 @@ class SubExecutor:
                 if node in ectx.params:
                     env[node] = ectx.params[node]
                     continue
+                if node in host_ops or (
+                        isinstance(node, PlaceholderOp)
+                        and node not in ectx.params):
+                    # host boundary (PS push/pull happens between compiled
+                    # steps) or an unmaterialized PS table: no device value
+                    env[node] = None
+                    continue
                 env[node] = node.compute(
                     [env[i] for i in node.inputs], ectx)
             outputs = [None if n in optimizer_set else env[n]
@@ -277,7 +309,11 @@ class SubExecutor:
                 n, state[str(n.id)]) for n in state_order}
             new_opt = (ectx.new_opt_state if ectx.new_opt_state is not None
                        else opt_state)
-            return outputs, new_params, new_state, new_opt
+            # PS-managed gradients leave the compiled region as outputs;
+            # the PS runtime pushes them after the step
+            ps_grads = [env[op.inputs[0]] if op.inputs else None
+                        for op in ps_ops]
+            return outputs, new_params, new_state, new_opt, ps_grads
 
         return step_fn
 
@@ -291,8 +327,7 @@ class SubExecutor:
         lr = jnp.float32(0.0)
         for opt in self.optimizer_ops:
             lr = jnp.float32(opt.optimizer.learning_rate)
-        feeds = [feed_map[n] for n in
-                 (list(self.feed_nodes) + list(self.dataloader_ops))]
+        feeds = [feed_map[n] for n in self._feed_order()]
         return (executor.params, executor.state, executor.opt_state, feeds,
                 lr, jnp.int32(self.step_count),
                 executor.rngkey(self.step_count))
@@ -306,9 +341,10 @@ class SubExecutor:
 
     # ------------------------------------------------------------------
     def run(self, executor, feed_dict=None, convert_to_numpy_ret_vals=False):
-        assert not self.ps_ops or executor.ps_runtime is not None, \
+        needs_ps = self.ps_ops or self.ps_lookups or self.ps_pull_ops
+        assert not needs_ps or executor.ps_runtime is not None, \
             "PS-mode graph requires the parameter-server runtime"
-        if self.ps_ops:
+        if needs_ps:
             return executor.ps_runtime.run_step(
                 self, feed_dict, convert_to_numpy_ret_vals)
         feed_dict = feed_dict or {}
@@ -326,7 +362,7 @@ class SubExecutor:
             self.compiled[key] = self._compile_step()
         fn = self.compiled[key]
 
-        outputs, new_params, new_state, new_opt = fn(
+        outputs, new_params, new_state, new_opt, _ = fn(
             *self.trace_args(executor, feed_map))
         if self.training:
             executor.params = new_params
@@ -387,7 +423,11 @@ class Executor:
         self._param_nodes = {}
         topo = find_topo_sort(all_eval_nodes)
         repl = config.replicated_sharding()
+        ps_embeds = {op.parameter for op in config.ps_nodes
+                     if getattr(op.parameter, "is_embed", False)}
         for node in topo:
+            if node in ps_embeds:
+                continue        # lives on the PS server only
             if isinstance(node, PlaceholderOp) and (
                     node.tensor_value is not None
                     or node.initializer is not None):
@@ -415,9 +455,18 @@ class Executor:
                     self.opt_state.update(n.optimizer.init_state(by_node))
 
         self._base_rng = jax.random.PRNGKey(config.seed)
-        self.subexecutors = {
-            name: SubExecutor(name, nodes, config)
-            for name, nodes in eval_node_dict.items()}
+        if config.use_gpipe or config.use_pipedream:
+            from .parallel.pipeline import PipelineSubExecutor
+            schedule = "gpipe" if config.use_gpipe else "1f1b"
+            self.subexecutors = {
+                name: PipelineSubExecutor(
+                    name, nodes, config, schedule=schedule,
+                    num_microbatches=config.num_microbatches)
+                for name, nodes in eval_node_dict.items()}
+        else:
+            self.subexecutors = {
+                name: SubExecutor(name, nodes, config)
+                for name, nodes in eval_node_dict.items()}
 
         # -- PS runtime ------------------------------------------------
         if config.ps_comm is not None:

@@ -40,6 +40,12 @@ class BaseInit:
     def init_numpy(self, seed=0):
         raise NotImplementedError
 
+    def dist_spec(self):
+        """(init_kind, a, b) for on-server initialization (the PS server
+        mirrors reference PSFHandle.h:277-342). init_kind: 0=constant(a),
+        1=uniform(a,b), 2=normal(mean=a, std=b), 3=truncated normal."""
+        return None
+
     def __call__(self, name, trainable=True, dtype=np.float32, ctx=None):
         from .ops.variable import placeholder_op
         return placeholder_op(name, value=None, initializer=self,
@@ -53,6 +59,9 @@ class ConstantInit(BaseInit):
 
     def init_numpy(self, seed=0):
         return np.full(self.shape, self.constant, dtype=np.float32)
+
+    def dist_spec(self):
+        return (0, float(self.constant), 0.0)
 
 
 class ZerosInit(ConstantInit):
@@ -76,6 +85,9 @@ class UniformInit(BaseInit):
         return rng.uniform(self.minval, self.maxval,
                            self.shape).astype(np.float32)
 
+    def dist_spec(self):
+        return (1, float(self.minval), float(self.maxval))
+
 
 class NormalInit(BaseInit):
     def __init__(self, shape, mean=0.0, stddev=0.05):
@@ -87,6 +99,9 @@ class NormalInit(BaseInit):
         rng = np.random.RandomState(seed)
         return rng.normal(self.mean, self.stddev,
                           self.shape).astype(np.float32)
+
+    def dist_spec(self):
+        return (2, float(self.mean), float(self.stddev))
 
 
 class TruncatedNormalInit(BaseInit):
@@ -109,6 +124,9 @@ class TruncatedNormalInit(BaseInit):
                 self.mean + 2 * self.stddev, out=out)
         return out.astype(np.float32)
 
+    def dist_spec(self):
+        return (3, float(self.mean), float(self.stddev))
+
 
 class _VarianceScaling(BaseInit):
     scale_mode = "fan_avg"
@@ -130,6 +148,15 @@ class _VarianceScaling(BaseInit):
             return rng.normal(0.0, std, self.shape).astype(np.float32)
         limit = self.gain * np.sqrt(3.0 / denom)
         return rng.uniform(-limit, limit, self.shape).astype(np.float32)
+
+    def dist_spec(self):
+        fan_in, fan_out = _fans(self.shape)
+        denom = {"fan_in": fan_in, "fan_out": fan_out,
+                 "fan_avg": (fan_in + fan_out) / 2}[self.scale_mode]
+        if self.distribution == "normal":
+            return (2, 0.0, float(self.gain * np.sqrt(1.0 / denom)))
+        limit = float(self.gain * np.sqrt(3.0 / denom))
+        return (1, -limit, limit)
 
 
 class XavierNormalInit(_VarianceScaling):

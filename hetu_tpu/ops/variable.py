@@ -75,14 +75,19 @@ class PlaceholderOp(Op):
         return tuple(s // p for s, p in zip(self.shape, parts))
 
     def initial_value(self, rng=None, seed=0):
-        """Materialize the initial value as a numpy/jax array."""
+        """Materialize the initial value as a numpy/jax array. The draw is
+        seeded from the parameter *name* (not the global node-id counter)
+        so initialization is stable regardless of how many graphs were
+        built earlier in the process."""
         if self.tensor_value is not None:
             if isinstance(self.tensor_value, ndarray.NDArray):
                 return self.tensor_value.asnumpy()
             return np.asarray(self.tensor_value, dtype=self.dtype)
         assert self.initializer is not None, \
             f"placeholder {self.name} has no value"
-        return self.initializer.init_numpy(seed=seed + self.id)
+        import zlib
+        tag = zlib.crc32(self.name.encode())
+        return self.initializer.init_numpy(seed=seed + tag)
 
 
 def Variable(name, value=None, initializer=None, trainable=True,

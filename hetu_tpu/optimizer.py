@@ -285,7 +285,7 @@ class OptimizerOp(Op):
         self.comm_mode = config.comm_mode
         new_inputs = []
         for grad, param in zip(self.inputs, self.optimizer.params):
-            strategy = config.node_strategy.get(param, config.comm_mode)
+            strategy = config.node_strategy.get(param) or config.comm_mode
             if strategy == "PS" or (strategy == "Hybrid" and param.is_embed):
                 comm = parameterServerCommunicate_op(
                     grad, param, self.optimizer, ctx=grad.raw_ctx)

@@ -11,3 +11,5 @@ milestone:
   * ``ring``     — ring attention / sequence parallelism (new capability,
                    absent in the reference — SURVEY.md §5).
 """
+from .mesh import build_mesh, factorized_axes, mesh_for_statuses
+from .planner import assign_states, spec_for_status

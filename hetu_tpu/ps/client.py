@@ -1,16 +1,181 @@
-"""PS client placeholder — fully implemented with the C++ server in the PS
-milestone; these entry points keep the executor importable before that."""
+"""Worker-side PS client (reference parity: KVWorker/PSAgent via
+python_binding.cc, wrapped like python/hetu/communicator usage).
+
+Numpy-level API over the C client in libhetu_ps.so. Async ops (push,
+dd_pushpull, sparse_push) return immediately; ``wait(tensor_id)`` blocks
+until that tensor's outstanding requests complete — the PSEvent contract
+(reference stream.py:67-81).
+"""
 from __future__ import annotations
 
+import os
+
+import numpy as np
+
+from .native_lib import as_f32, as_i64, fptr, get_lib, lptr
+
 _default_client = None
+
+# reference OptType mapping (ps/server/optimizer.h:15-22)
+OPT_KIND = {"SGD": 0, "Momentum": 1, "Nesterov": 2, "AdaGrad": 3,
+            "Adam": 4, "None": 5}
+
+
+class PSClient:
+    def __init__(self, hosts=None, ports=None, rank=0, nworkers=1):
+        hosts = hosts or os.environ.get("HETU_PS_HOSTS", "127.0.0.1")
+        ports = ports or os.environ.get("HETU_PS_PORTS", "18590")
+        self.lib = get_lib()
+        self.nservers = self.lib.PSInit(
+            hosts.encode(), str(ports).encode(), rank, nworkers)
+        self.rank = rank
+        self.nworkers = nworkers
+        # fail fast on a dead server (async paths would otherwise drop
+        # requests silently)
+        import socket
+        host0 = hosts.split(",")[0]
+        port0 = int(str(ports).split(",")[0])
+        try:
+            socket.create_connection((host0, port0), timeout=2).close()
+        except OSError as e:
+            raise RuntimeError(
+                f"no PS server reachable at {host0}:{port0}; start one "
+                f"with hetu_tpu.ps.server.ensure_server() or the heturun "
+                f"launcher") from e
+
+    # -- registration ---------------------------------------------------
+    def init_tensor(self, tid, shape, kind=0, init=(0, 0.0, 0.0), seed=0,
+                    opt="None", lrs=(0.1,)):
+        length = int(shape[0]) if len(shape) > 1 else int(np.prod(shape))
+        width = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        lrs = as_f32(np.asarray(lrs))
+        rc = self.lib.InitTensor(
+            tid, kind, length, width, int(init[0]), float(init[1]),
+            float(init[2]), int(seed), OPT_KIND[opt], fptr(lrs), len(lrs))
+        assert rc == 0, f"InitTensor({tid}) failed: {rc}"
+
+    def set_param(self, tid, value):
+        v = as_f32(value).ravel()
+        rc = self.lib.SetParam(tid, fptr(v), v.size)
+        assert rc == 0, f"SetParam({tid}) failed: {rc}"
+
+    # -- dense ----------------------------------------------------------
+    def pull(self, tid, shape):
+        out = np.empty(int(np.prod(shape)), np.float32)
+        rc = self.lib.Pull(tid, fptr(out), out.size)
+        assert rc == 0, f"Pull({tid}) failed: {rc}"
+        return out.reshape(shape)
+
+    def push(self, tid, grad):
+        g = as_f32(grad).ravel()
+        self.lib.Push(tid, fptr(g), g.size)
+
+    def dd_pushpull(self, tid, grad, out=None):
+        g = as_f32(grad).ravel()
+        if out is None:
+            out = np.empty_like(g)
+        # the C call is async and keeps a raw pointer: the output buffer
+        # must be the caller-visible contiguous memory, not a ravel() copy
+        assert out.dtype == np.float32 and out.flags["C_CONTIGUOUS"], \
+            "dd_pushpull needs a C-contiguous float32 output buffer"
+        self.lib.DDPushPull(tid, fptr(g), fptr(out), g.size)
+        return out
+
+    # -- sparse ---------------------------------------------------------
+    def sparse_push(self, tid, indices, values, width):
+        idx = as_i64(indices).ravel()
+        vals = as_f32(values).reshape(idx.size, width)
+        self.lib.SparsePush(tid, lptr(idx), fptr(vals), idx.size, width)
+
+    def sparse_pull(self, tid, indices, width):
+        idx = as_i64(indices).ravel()
+        out = np.empty((idx.size, width), np.float32)
+        rc = self.lib.SparsePull(tid, lptr(idx), fptr(out), idx.size, width)
+        assert rc == 0, f"SparsePull({tid}) failed: {rc}"
+        return out.reshape(tuple(np.shape(indices)) + (width,))
+
+    def sd_pushpull(self, tid, indices, values, width, out_len):
+        idx = as_i64(indices).ravel()
+        vals = as_f32(values).reshape(idx.size, width)
+        out = np.empty(out_len, np.float32)
+        self.lib.SDPushPull(tid, lptr(idx), fptr(vals), idx.size,
+                            fptr(out), out_len, width)
+        return out
+
+    def ss_pushpull(self, tid, push_idx, values, pull_idx, width):
+        pidx = as_i64(push_idx).ravel()
+        vals = as_f32(values).reshape(pidx.size, width)
+        oidx = as_i64(pull_idx).ravel()
+        out = np.empty((oidx.size, width), np.float32)
+        self.lib.SSPushPull(tid, lptr(pidx), fptr(vals), pidx.size,
+                            lptr(oidx), oidx.size, fptr(out), width)
+        return out.reshape(tuple(np.shape(pull_idx)) + (width,))
+
+    # -- bounded-staleness cache protocol -------------------------------
+    def sync_embedding(self, tid, bound, indices, versions, out_rows,
+                       width):
+        """Refresh rows of ``out_rows`` whose server version is more than
+        ``bound`` ahead of ``versions``; updates versions in place.
+        Returns refreshed-row count (cache miss-rate numerator)."""
+        idx = as_i64(indices).ravel()
+        ver = as_i64(versions).ravel()
+        n = self.lib.SyncEmbedding(tid, int(bound), lptr(idx), lptr(ver),
+                                   idx.size, fptr(out_rows), width)
+        versions[...] = ver.reshape(np.shape(versions))
+        return n
+
+    def push_embedding(self, tid, indices, values, updates, width):
+        idx = as_i64(indices).ravel()
+        vals = as_f32(values).reshape(idx.size, width)
+        upd = as_i64(updates).ravel()
+        self.lib.PushEmbedding(tid, lptr(idx), fptr(vals), lptr(upd),
+                               idx.size, width)
+
+    # -- control --------------------------------------------------------
+    def wait(self, tid):
+        self.lib.Wait(tid)
+
+    def wait_all(self):
+        self.lib.WaitAll()
+
+    def barrier(self):
+        self.lib.BarrierWorker()
+
+    def clear(self, tid):
+        return self.lib.Clear(tid)
+
+    def save_param(self, tid, path):
+        return self.lib.SaveParam(tid, str(path).encode())
+
+    def load_param(self, tid, path):
+        return self.lib.LoadParam(tid, str(path).encode())
+
+    def push_data(self, key, values):
+        v = as_f32(values).ravel()
+        return self.lib.PushData(int(key), fptr(v), v.size)
+
+    def pull_data(self, key, n):
+        out = np.empty(int(n), np.float32)
+        rc = self.lib.PullData(int(key), fptr(out), out.size)
+        assert rc == 0, f"PullData({key}) failed: {rc}"
+        return out
+
+    def get_loads(self):
+        return int(self.lib.GetLoads())
+
+    def shutdown_servers(self):
+        self.lib.ShutdownServers()
+
+    def close(self):
+        self.lib.PSFinalize()
 
 
 def get_default_client():
     global _default_client
     if _default_client is None:
-        raise RuntimeError(
-            "parameter-server mode requested but no PS is running; "
-            "start one with hetu_tpu.ps.server or the heturun launcher")
+        rank = int(os.environ.get("HETU_PS_RANK", "0"))
+        nworkers = int(os.environ.get("HETU_PS_NWORKERS", "1"))
+        _default_client = PSClient(rank=rank, nworkers=nworkers)
     return _default_client
 
 

@@ -1,0 +1,518 @@
+// hetu-tpu parameter-server client (worker-side C++).
+//
+// TPU-native counterpart of the reference's KVWorker/PSAgent
+// (ps-lite/include/ps/worker/PSAgent.h tensor registry + push/pull
+// assembly, python_binding.cc:6-140 C ABI): a connection pool to the PS
+// hosts, an async request thread pool with per-tensor pending counters
+// (the ``Wait(node_id)`` / PSEvent contract, stream.py:67-81), and
+// multi-server tensor placement (tensor id -> server, the Block-partition
+// analogue of ps/partitioner.h).
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "ps_common.h"
+
+namespace hetups {
+
+static bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Conn {
+  int fd = -1;
+  bool ok() const { return fd >= 0; }
+};
+
+static int dial(const std::string& host, int port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof portstr, "%d", port);
+  if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0) return -1;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+  ::freeaddrinfo(res);
+  if (rc != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int nd = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof nd);
+  return fd;
+}
+
+class Client {
+ public:
+  static Client& Get() {
+    static Client c;
+    return c;
+  }
+
+  int init(const char* hosts_csv, const char* ports_csv, int rank,
+           int nworkers) {
+    std::lock_guard<std::mutex> l(init_mu_);
+    if (!servers_.empty()) return 0;
+    {
+      std::lock_guard<std::mutex> ql(q_mu_);
+      stopping_ = false;    // singleton may re-init after finalize()
+    }
+    rank_ = rank;
+    nworkers_ = nworkers;
+    std::string hs(hosts_csv), ps(ports_csv);
+    size_t hp = 0, pp = 0;
+    while (hp < hs.size()) {
+      size_t he = hs.find(',', hp);
+      size_t pe = ps.find(',', pp);
+      std::string host = hs.substr(
+          hp, he == std::string::npos ? std::string::npos : he - hp);
+      int port = std::atoi(
+          ps.substr(pp, pe == std::string::npos ? std::string::npos
+                                                : pe - pp)
+              .c_str());
+      servers_.push_back({host, port});
+      if (he == std::string::npos) break;
+      hp = he + 1;
+      pp = pe + 1;
+    }
+    // worker thread pool drains the async queue; detached so process
+    // teardown without PSFinalize can't terminate() on joinable threads
+    for (int i = 0; i < 4; ++i)
+      std::thread([this] { this->worker_loop(); }).detach();
+    return static_cast<int>(servers_.size());
+  }
+
+  void finalize() {
+    {
+      std::lock_guard<std::mutex> l(q_mu_);
+      stopping_ = true;
+      q_cv_.notify_all();
+    }
+    for (auto& kv : pool_)
+      for (auto& c : kv.second)
+        if (c.ok()) ::close(c.fd);
+    pool_.clear();
+    servers_.clear();
+  }
+
+  int server_of(int32_t tensor_id) const {
+    return servers_.empty() ? 0
+                            : tensor_id % static_cast<int>(servers_.size());
+  }
+
+  // synchronous RPC
+  int32_t call(int server, Op op, int32_t id, const Writer& req,
+               std::vector<uint8_t>* resp) {
+    Conn c = take_conn(server);
+    if (!c.ok()) return -10;
+    MsgHeader h;
+    h.op = static_cast<uint32_t>(op);
+    h.tensor_id = id;
+    h.payload_len = req.buf.size();
+    int32_t status = -11;
+    if (write_full(c.fd, &h, sizeof h) &&
+        (req.buf.empty() ||
+         write_full(c.fd, req.buf.data(), req.buf.size()))) {
+      MsgHeader rh;
+      if (read_full(c.fd, &rh, sizeof rh)) {
+        std::vector<uint8_t> body(rh.payload_len);
+        if (!rh.payload_len ||
+            read_full(c.fd, body.data(), rh.payload_len)) {
+          status = rh.status;
+          if (resp) *resp = std::move(body);
+        }
+      }
+    }
+    give_conn(server, c);
+    return status;
+  }
+
+  // async submit with per-tensor pending counter
+  void submit(int32_t id, std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> l(pend_mu_);
+      ++pending_[id];
+    }
+    std::lock_guard<std::mutex> l(q_mu_);
+    queue_.emplace_back(id, std::move(fn));
+    q_cv_.notify_one();
+  }
+
+  void wait(int32_t id) {
+    std::unique_lock<std::mutex> l(pend_mu_);
+    pend_cv_.wait(l, [&] { return pending_[id] == 0; });
+  }
+
+  void wait_all() {
+    std::unique_lock<std::mutex> l(pend_mu_);
+    pend_cv_.wait(l, [&] {
+      for (auto& kv : pending_)
+        if (kv.second) return false;
+      return true;
+    });
+  }
+
+  int rank() const { return rank_; }
+  int nworkers() const { return nworkers_; }
+
+ private:
+  void worker_loop() {
+    for (;;) {
+      std::pair<int32_t, std::function<void()>> job;
+      {
+        std::unique_lock<std::mutex> l(q_mu_);
+        q_cv_.wait(l, [&] { return stopping_ || !queue_.empty(); });
+        if (stopping_ && queue_.empty()) return;
+        job = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      job.second();
+      {
+        std::lock_guard<std::mutex> l(pend_mu_);
+        if (--pending_[job.first] == 0) pend_cv_.notify_all();
+      }
+    }
+  }
+
+  Conn take_conn(int server) {
+    {
+      std::lock_guard<std::mutex> l(pool_mu_);
+      auto& v = pool_[server];
+      if (!v.empty()) {
+        Conn c = v.back();
+        v.pop_back();
+        return c;
+      }
+    }
+    Conn c;
+    c.fd = dial(servers_[server].first, servers_[server].second);
+    return c;
+  }
+
+  void give_conn(int server, Conn c) {
+    if (!c.ok()) return;
+    std::lock_guard<std::mutex> l(pool_mu_);
+    pool_[server].push_back(c);
+  }
+
+  std::mutex init_mu_;
+  std::vector<std::pair<std::string, int>> servers_;
+  std::unordered_map<int, std::vector<Conn>> pool_;
+  std::mutex pool_mu_;
+
+  std::deque<std::pair<int32_t, std::function<void()>>> queue_;
+  std::mutex q_mu_;
+  std::condition_variable q_cv_;
+  bool stopping_ = false;
+
+  std::unordered_map<int32_t, int> pending_;
+  std::mutex pend_mu_;
+  std::condition_variable pend_cv_;
+
+  int rank_ = 0;
+  int nworkers_ = 1;
+};
+
+}  // namespace hetups
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes) — mirrors the reference python_binding.cc surface
+// ---------------------------------------------------------------------------
+
+using hetups::Client;
+using hetups::Op;
+using hetups::Writer;
+
+extern "C" {
+
+int PSInit(const char* hosts_csv, const char* ports_csv, int rank,
+           int nworkers) {
+  return Client::Get().init(hosts_csv, ports_csv, rank, nworkers);
+}
+
+void PSFinalize() { Client::Get().finalize(); }
+
+int PSRank() { return Client::Get().rank(); }
+int PSNumWorkers() { return Client::Get().nworkers(); }
+
+int InitTensor(int id, int ptype, int64_t len, int64_t width, int init_type,
+               double init_a, double init_b, uint64_t seed, int otype,
+               const float* lrs, int nlr) {
+  Writer w;
+  w.i32(ptype);
+  w.i64(len);
+  w.i64(width);
+  w.i32(init_type);
+  w.f64(init_a);
+  w.f64(init_b);
+  w.u64(seed);
+  w.i32(otype);
+  w.floats(lrs, static_cast<size_t>(nlr));
+  auto& c = Client::Get();
+  return c.call(c.server_of(id), Op::kInitTensor, id, w, nullptr);
+}
+
+int Pull(int id, float* out, int64_t len) {
+  auto& c = Client::Get();
+  std::vector<uint8_t> resp;
+  Writer w;
+  int rc = c.call(c.server_of(id), Op::kDensePull, id, w, &resp);
+  if (rc != 0) return rc;
+  hetups::Reader rd(resp.data(), resp.size());
+  size_t n;
+  const float* p = rd.floats(&n);
+  std::memcpy(out, p, std::min<size_t>(n, len) * sizeof(float));
+  return 0;
+}
+
+void Push(int id, const float* grad, int64_t len) {
+  auto& c = Client::Get();
+  std::vector<float> g(grad, grad + len);
+  c.submit(id, [&c, id, g = std::move(g)] {
+    Writer w;
+    w.floats(g.data(), g.size());
+    c.call(c.server_of(id), Op::kDensePush, id, w, nullptr);
+  });
+}
+
+void DDPushPull(int id, const float* grad, float* out, int64_t len) {
+  auto& c = Client::Get();
+  std::vector<float> g(grad, grad + len);
+  c.submit(id, [&c, id, g = std::move(g), out, len] {
+    Writer w;
+    w.floats(g.data(), g.size());
+    std::vector<uint8_t> resp;
+    if (c.call(c.server_of(id), Op::kDDPushPull, id, w, &resp) == 0) {
+      hetups::Reader rd(resp.data(), resp.size());
+      size_t n;
+      const float* p = rd.floats(&n);
+      std::memcpy(out, p, std::min<size_t>(n, len) * sizeof(float));
+    }
+  });
+}
+
+void SparsePush(int id, const int64_t* idx, const float* vals, int64_t nidx,
+                int64_t width) {
+  auto& c = Client::Get();
+  std::vector<int64_t> iv(idx, idx + nidx);
+  std::vector<float> vv(vals, vals + nidx * width);
+  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv)] {
+    Writer w;
+    w.longs(iv.data(), iv.size());
+    w.floats(vv.data(), vv.size());
+    c.call(c.server_of(id), Op::kSparsePush, id, w, nullptr);
+  });
+}
+
+int SparsePull(int id, const int64_t* idx, float* out, int64_t nidx,
+               int64_t width) {
+  auto& c = Client::Get();
+  Writer w;
+  w.longs(idx, static_cast<size_t>(nidx));
+  std::vector<uint8_t> resp;
+  int rc = c.call(c.server_of(id), Op::kSparsePull, id, w, &resp);
+  if (rc != 0) return rc;
+  hetups::Reader rd(resp.data(), resp.size());
+  size_t n;
+  const float* p = rd.floats(&n);
+  std::memcpy(out, p,
+              std::min<size_t>(n, nidx * width) * sizeof(float));
+  return 0;
+}
+
+void SDPushPull(int id, const int64_t* idx, const float* vals, int64_t nidx,
+                float* out, int64_t out_len, int64_t width) {
+  auto& c = Client::Get();
+  std::vector<int64_t> iv(idx, idx + nidx);
+  std::vector<float> vv(vals, vals + nidx * width);
+  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv), out,
+                out_len] {
+    Writer w;
+    w.longs(iv.data(), iv.size());
+    w.floats(vv.data(), vv.size());
+    std::vector<uint8_t> resp;
+    if (c.call(c.server_of(id), Op::kSDPushPull, id, w, &resp) == 0) {
+      hetups::Reader rd(resp.data(), resp.size());
+      size_t n;
+      const float* p = rd.floats(&n);
+      std::memcpy(out, p, std::min<size_t>(n, out_len) * sizeof(float));
+    }
+  });
+}
+
+void SSPushPull(int id, const int64_t* in_idx, const float* vals,
+                int64_t nin, const int64_t* out_idx, int64_t nout,
+                float* out, int64_t width) {
+  auto& c = Client::Get();
+  std::vector<int64_t> iv(in_idx, in_idx + nin);
+  std::vector<float> vv(vals, vals + nin * width);
+  std::vector<int64_t> ov(out_idx, out_idx + nout);
+  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv),
+                ov = std::move(ov), out, nout, width] {
+    Writer w;
+    w.longs(iv.data(), iv.size());
+    w.floats(vv.data(), vv.size());
+    w.longs(ov.data(), ov.size());
+    std::vector<uint8_t> resp;
+    if (c.call(c.server_of(id), Op::kSSPushPull, id, w, &resp) == 0) {
+      hetups::Reader rd(resp.data(), resp.size());
+      size_t n;
+      const float* p = rd.floats(&n);
+      std::memcpy(out, p,
+                  std::min<size_t>(n, nout * width) * sizeof(float));
+    }
+  });
+}
+
+// bounded-staleness cache sync: for rows in idx whose server version is
+// newer than ver[j]+bound, writes row data into out (at position j*width),
+// updates ver[j]; returns number of refreshed rows.
+int SyncEmbedding(int id, int64_t bound, const int64_t* idx, int64_t* ver,
+                  int64_t nidx, float* out, int64_t width) {
+  auto& c = Client::Get();
+  Writer w;
+  w.i64(bound);
+  w.longs(idx, static_cast<size_t>(nidx));
+  w.longs(ver, static_cast<size_t>(nidx));
+  std::vector<uint8_t> resp;
+  int rc = c.call(c.server_of(id), Op::kSyncEmbedding, id, w, &resp);
+  if (rc != 0) return rc < 0 ? rc : -rc;
+  hetups::Reader rd(resp.data(), resp.size());
+  size_t npos, nver, nrows;
+  const int64_t* pos = rd.longs(&npos);
+  const int64_t* sver = rd.longs(&nver);
+  const float* rows = rd.floats(&nrows);
+  for (size_t j = 0; j < npos; ++j) {
+    int64_t p = pos[j];
+    ver[p] = sver[j];
+    std::memcpy(out + p * width, rows + j * width,
+                width * sizeof(float));
+  }
+  return static_cast<int>(npos);
+}
+
+void PushEmbedding(int id, const int64_t* idx, const float* vals,
+                   const int64_t* updates, int64_t nidx, int64_t width) {
+  auto& c = Client::Get();
+  std::vector<int64_t> iv(idx, idx + nidx);
+  std::vector<float> vv(vals, vals + nidx * width);
+  std::vector<int64_t> uv(updates, updates + nidx);
+  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv),
+                uv = std::move(uv)] {
+    Writer w;
+    w.longs(iv.data(), iv.size());
+    w.floats(vv.data(), vv.size());
+    w.longs(uv.data(), uv.size());
+    c.call(c.server_of(id), Op::kPushEmbedding, id, w, nullptr);
+  });
+}
+
+void Wait(int id) { Client::Get().wait(id); }
+void WaitAll() { Client::Get().wait_all(); }
+
+void BarrierWorker() {
+  auto& c = Client::Get();
+  Writer w;
+  c.call(0, Op::kBarrier, 0, w, nullptr);
+}
+
+int SetParam(int id, const float* vals, int64_t len) {
+  auto& c = Client::Get();
+  Writer w;
+  w.floats(vals, static_cast<size_t>(len));
+  return c.call(c.server_of(id), Op::kParamSet, id, w, nullptr);
+}
+
+int Clear(int id) {
+  auto& c = Client::Get();
+  Writer w;
+  return c.call(c.server_of(id), Op::kParamClear, id, w, nullptr);
+}
+
+int SaveParam(int id, const char* path) {
+  auto& c = Client::Get();
+  Writer w;
+  w.str(path);
+  return c.call(c.server_of(id), Op::kParamSave, id, w, nullptr);
+}
+
+int LoadParam(int id, const char* path) {
+  auto& c = Client::Get();
+  Writer w;
+  w.str(path);
+  return c.call(c.server_of(id), Op::kParamLoad, id, w, nullptr);
+}
+
+int PushData(int64_t key, const float* vals, int64_t n) {
+  auto& c = Client::Get();
+  Writer w;
+  w.i64(key);
+  w.floats(vals, static_cast<size_t>(n));
+  return c.call(0, Op::kPushData, 0, w, nullptr);
+}
+
+int PullData(int64_t key, float* out, int64_t n) {
+  auto& c = Client::Get();
+  Writer w;
+  w.i64(key);
+  std::vector<uint8_t> resp;
+  int rc = c.call(0, Op::kPullData, 0, w, &resp);
+  if (rc != 0) return rc;
+  hetups::Reader rd(resp.data(), resp.size());
+  size_t m;
+  const float* p = rd.floats(&m);
+  std::memcpy(out, p, std::min<size_t>(m, n) * sizeof(float));
+  return 0;
+}
+
+uint64_t GetLoads() {
+  auto& c = Client::Get();
+  Writer w;
+  std::vector<uint8_t> resp;
+  if (c.call(0, Op::kGetLoads, 0, w, &resp) != 0) return 0;
+  hetups::Reader rd(resp.data(), resp.size());
+  return rd.u64();
+}
+
+void ShutdownServers() {
+  auto& c = Client::Get();
+  Writer w;
+  c.call(0, Op::kShutdown, 0, w, nullptr);
+}
+
+}  // extern "C"

@@ -1,0 +1,593 @@
+// hetu-tpu parameter server (host-side C++).
+//
+// TPU-native equivalent of the reference's ps-lite server
+// (ps-lite/include/ps/server/PSFHandle.h KVServerMatrixHandle +
+// ps/server/optimizer.h server-side optimizers + PSFhandle_embedding.cc
+// versioned cache tables): tensors live in host RAM behind per-tensor
+// reader/writer locks, updates apply OpenMP-parallel, sparse tables keep
+// per-row versions for the bounded-staleness embedding-cache protocol.
+// Transport is plain TCP threads (the reference's ZMQ/P3/IBVerbs vans
+// collapse to this on a TPU pod: workers talk to host PS over DCN).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <shared_mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "ps_common.h"
+
+namespace hetups {
+
+static bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+using version_t = int64_t;
+
+struct Tensor {
+  ParamKind kind = ParamKind::kParam;
+  OptKind opt = OptKind::kNone;
+  int64_t len = 0;    // rows (or flat length for dense)
+  int64_t width = 1;  // row width for 2-D tables
+  std::vector<float> data;
+  std::vector<version_t> ver;       // per-row versions (cache tables)
+  std::vector<float> lrs;           // [lr, momentum/beta1, beta2, eps...]
+  // optimizer slots
+  std::vector<float> m, v;
+  int64_t step = 0;
+  mutable std::shared_mutex mu;
+
+  int64_t nelem() const { return len * width; }
+  float lr() const { return lrs.empty() ? 0.1f : lrs[0]; }
+
+  void init_slots() {
+    switch (opt) {
+      case OptKind::kMomentum:
+      case OptKind::kNesterov:
+      case OptKind::kAdaGrad:
+        m.assign(nelem(), 0.f);
+        break;
+      case OptKind::kAdam:
+        m.assign(nelem(), 0.f);
+        v.assign(nelem(), 0.f);
+        break;
+      default:
+        break;
+    }
+  }
+
+  // dense update over the full buffer (reference ApplyDense)
+  void apply_dense(const float* g) {
+    const int64_t n = nelem();
+    const float a = lr();
+    switch (opt) {
+      case OptKind::kNone:
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) data[i] += g[i];
+        break;
+      case OptKind::kSGD:
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) data[i] -= a * g[i];
+        break;
+      case OptKind::kMomentum:
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) {
+          m[i] = lrs[1] * m[i] - a * g[i];
+          data[i] += m[i];
+        }
+        break;
+      case OptKind::kNesterov:
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) {
+          float vel = lrs[1] * m[i] - a * g[i];
+          data[i] += lrs[1] * vel - a * g[i];
+          m[i] = vel;
+        }
+        break;
+      case OptKind::kAdaGrad:
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) {
+          m[i] += g[i] * g[i];
+          data[i] -= a * g[i] / (std::sqrt(m[i]) + lrs[1]);
+        }
+        break;
+      case OptKind::kAdam: {
+        ++step;
+        const float b1 = lrs[1], b2 = lrs[2], eps = lrs[3];
+        const float wd = lrs.size() > 4 ? lrs[4] : 0.f;  // AdamW decay
+        const float bc1 = 1.f - std::pow(b1, static_cast<float>(step));
+        const float bc2 = 1.f - std::pow(b2, static_cast<float>(step));
+        const float scale = a * std::sqrt(bc2) / bc1;
+#pragma omp parallel for
+        for (int64_t i = 0; i < n; ++i) {
+          m[i] = b1 * m[i] + (1 - b1) * g[i];
+          v[i] = b2 * v[i] + (1 - b2) * g[i] * g[i];
+          data[i] -= scale * m[i] / (std::sqrt(v[i]) + eps)
+                     + a * wd * data[i];
+        }
+        break;
+      }
+    }
+  }
+
+  // sparse row update (reference ApplySparse/ApplyCache); bumps versions
+  void apply_sparse(const int64_t* idx, size_t nidx, const float* g) {
+    const int64_t w = width;
+    const float a = lr();
+#pragma omp parallel for
+    for (size_t j = 0; j < nidx; ++j) {
+      int64_t row = idx[j];
+      if (row < 0 || row >= len) continue;
+      float* dst = data.data() + row * w;
+      const float* src = g + j * w;
+      switch (opt) {
+        case OptKind::kNone:
+          for (int64_t k = 0; k < w; ++k) dst[k] += src[k];
+          break;
+        case OptKind::kSGD:
+          for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
+          break;
+        case OptKind::kAdaGrad: {
+          float* acc = m.data() + row * w;
+          for (int64_t k = 0; k < w; ++k) {
+            acc[k] += src[k] * src[k];
+            dst[k] -= a * src[k] / (std::sqrt(acc[k]) + lrs[1]);
+          }
+          break;
+        }
+        case OptKind::kAdam: {
+          // row-wise adam without global bias correction (matches the
+          // reference's AdamOptimizer::ApplySparse per-row treatment)
+          const float b1 = lrs[1], b2 = lrs[2], eps = lrs[3];
+          float* mi = m.data() + row * w;
+          float* vi = v.data() + row * w;
+          for (int64_t k = 0; k < w; ++k) {
+            mi[k] = b1 * mi[k] + (1 - b1) * src[k];
+            vi[k] = b2 * vi[k] + (1 - b2) * src[k] * src[k];
+            dst[k] -= a * mi[k] / (std::sqrt(vi[k]) + eps);
+          }
+          break;
+        }
+        default:  // Momentum variants fall back to SGD row update
+          for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
+      }
+      if (!ver.empty()) ++ver[row];
+    }
+  }
+
+  void gather(const int64_t* idx, size_t nidx, float* out) const {
+    const int64_t w = width;
+#pragma omp parallel for
+    for (size_t j = 0; j < nidx; ++j) {
+      int64_t row = idx[j];
+      if (row < 0 || row >= len) {
+        std::memset(out + j * w, 0, w * sizeof(float));
+      } else {
+        std::memcpy(out + j * w, data.data() + row * w, w * sizeof(float));
+      }
+    }
+  }
+};
+
+class Server {
+ public:
+  Server(int port, int nworkers) : port_(port), nworkers_(nworkers) {}
+
+  int run() {
+    int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+      std::perror("hetu-ps bind");
+      return 1;
+    }
+    ::listen(lfd, 64);
+    std::fprintf(stderr, "[hetu-ps] serving on :%d (%d workers)\n", port_,
+                 nworkers_);
+    while (!stop_.load()) {
+      int cfd = ::accept(lfd, nullptr, nullptr);
+      if (cfd < 0) break;
+      int nd = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof nd);
+      std::thread(&Server::serve_conn, this, cfd).detach();
+    }
+    ::close(lfd);
+    return 0;
+  }
+
+ private:
+  Tensor* get(int32_t id) {
+    std::shared_lock<std::shared_mutex> l(store_mu_);
+    auto it = store_.find(id);
+    return it == store_.end() ? nullptr : it->second.get();
+  }
+
+  void serve_conn(int fd) {
+    std::vector<uint8_t> payload;
+    for (;;) {
+      MsgHeader h;
+      if (!read_full(fd, &h, sizeof h) || h.magic != 0x48505331) break;
+      payload.resize(h.payload_len);
+      if (h.payload_len && !read_full(fd, payload.data(), h.payload_len))
+        break;
+      Writer out;
+      int32_t status = handle(static_cast<Op>(h.op), h.tensor_id,
+                              payload, out);
+      MsgHeader rh;
+      rh.op = h.op;
+      rh.tensor_id = h.tensor_id;
+      rh.status = status;
+      rh.payload_len = out.buf.size();
+      if (!write_full(fd, &rh, sizeof rh)) break;
+      if (!out.buf.empty() &&
+          !write_full(fd, out.buf.data(), out.buf.size()))
+        break;
+      if (static_cast<Op>(h.op) == Op::kShutdown) {
+        stop_.store(true);
+        // poke the accept loop
+        int s = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in a{};
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        a.sin_port = htons(static_cast<uint16_t>(port_));
+        ::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof a);
+        ::close(s);
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int32_t handle(Op op, int32_t id, const std::vector<uint8_t>& payload,
+                 Writer& out) {
+    Reader rd(payload.data(), payload.size());
+    switch (op) {
+      case Op::kInitTensor: {
+        auto t = std::make_unique<Tensor>();
+        t->kind = static_cast<ParamKind>(rd.i32());
+        t->len = rd.i64();
+        t->width = rd.i64();
+        InitKind ik = static_cast<InitKind>(rd.i32());
+        double a = rd.f64(), b = rd.f64();
+        uint64_t seed = rd.u64();
+        t->opt = static_cast<OptKind>(rd.i32());
+        size_t nlr;
+        const float* lrp = rd.floats(&nlr);
+        t->lrs.assign(lrp, lrp + nlr);
+        t->data.resize(t->nelem());
+        // on-server init (reference PSFHandle.h:277-342)
+        std::mt19937_64 gen(seed ? seed : 0x9e3779b9);
+        switch (ik) {
+          case InitKind::kConstant:
+            std::fill(t->data.begin(), t->data.end(),
+                      static_cast<float>(a));
+            break;
+          case InitKind::kUniform: {
+            std::uniform_real_distribution<float> d(
+                static_cast<float>(a), static_cast<float>(b));
+            for (auto& x : t->data) x = d(gen);
+            break;
+          }
+          case InitKind::kNormal: {
+            std::normal_distribution<float> d(static_cast<float>(a),
+                                              static_cast<float>(b));
+            for (auto& x : t->data) x = d(gen);
+            break;
+          }
+          case InitKind::kTruncatedNormal: {
+            std::normal_distribution<float> d(static_cast<float>(a),
+                                              static_cast<float>(b));
+            for (auto& x : t->data) {
+              do {
+                x = d(gen);
+              } while (std::fabs(x - a) > 2 * b);
+            }
+            break;
+          }
+        }
+        t->init_slots();
+        if (t->kind == ParamKind::kCacheTable) t->ver.assign(t->len, 0);
+        {
+          std::unique_lock<std::shared_mutex> l(store_mu_);
+          // idempotent across workers: first init wins (reference
+          // PSFHandle ParamInit re-registration is a no-op)
+          if (!store_.count(id)) store_[id] = std::move(t);
+        }
+        return 0;
+      }
+      case Op::kDensePull: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        std::shared_lock<std::shared_mutex> l(t->mu);
+        out.floats(t->data.data(), t->data.size());
+        return 0;
+      }
+      case Op::kDensePush:
+      case Op::kDDPushPull: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t n;
+        const float* g = rd.floats(&n);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        if (static_cast<int64_t>(n) == t->nelem()) t->apply_dense(g);
+        if (op == Op::kDDPushPull)
+          out.floats(t->data.data(), t->data.size());
+        bytes_in_ += n * 4;
+        return 0;
+      }
+      case Op::kSparsePull: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t nidx;
+        const int64_t* idx = rd.longs(&nidx);
+        std::shared_lock<std::shared_mutex> l(t->mu);
+        out.i64(static_cast<int64_t>(nidx * t->width));
+        size_t off = out.buf.size();
+        out.buf.resize(off + nidx * t->width * sizeof(float));
+        t->gather(idx, nidx,
+                  reinterpret_cast<float*>(out.buf.data() + off));
+        return 0;
+      }
+      case Op::kSparsePush: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t nidx, nval;
+        const int64_t* idx = rd.longs(&nidx);
+        const float* g = rd.floats(&nval);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        t->apply_sparse(idx, nidx, g);
+        bytes_in_ += nval * 4;
+        return 0;
+      }
+      case Op::kSDPushPull: {
+        // push sparse grad rows, pull the full dense tensor
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t nidx, nval;
+        const int64_t* idx = rd.longs(&nidx);
+        const float* g = rd.floats(&nval);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        t->apply_sparse(idx, nidx, g);
+        out.floats(t->data.data(), t->data.size());
+        return 0;
+      }
+      case Op::kSSPushPull: {
+        // push grad rows at in-indices, pull rows at out-indices (the
+        // prefetch pipeline: pull next batch's rows — reference
+        // SSPushPull, PSFHandle.h:217-268)
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t nin, nval, nout;
+        const int64_t* in_idx = rd.longs(&nin);
+        const float* g = rd.floats(&nval);
+        const int64_t* out_idx = rd.longs(&nout);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        t->apply_sparse(in_idx, nin, g);
+        out.i64(static_cast<int64_t>(nout * t->width));
+        size_t off = out.buf.size();
+        out.buf.resize(off + nout * t->width * sizeof(float));
+        t->gather(out_idx, nout,
+                  reinterpret_cast<float*>(out.buf.data() + off));
+        return 0;
+      }
+      case Op::kSyncEmbedding: {
+        // bounded staleness: return only rows whose server version
+        // exceeds the client's by more than `bound`
+        // (reference hetu_client.cc:6-38 / PSFhandle_embedding.cc)
+        Tensor* t = get(id);
+        if (!t || t->ver.empty()) return -1;
+        int64_t bound = rd.i64();
+        size_t nidx, nver;
+        const int64_t* idx = rd.longs(&nidx);
+        const int64_t* cver = rd.longs(&nver);
+        std::shared_lock<std::shared_mutex> l(t->mu);
+        std::vector<int64_t> stale_pos, stale_ver;
+        std::vector<float> rows;
+        for (size_t j = 0; j < nidx; ++j) {
+          int64_t row = idx[j];
+          if (row < 0 || row >= t->len) continue;
+          if (t->ver[row] - cver[j] > bound) {
+            stale_pos.push_back(static_cast<int64_t>(j));
+            stale_ver.push_back(t->ver[row]);
+            size_t o = rows.size();
+            rows.resize(o + t->width);
+            std::memcpy(rows.data() + o, t->data.data() + row * t->width,
+                        t->width * sizeof(float));
+          }
+        }
+        out.longs(stale_pos.data(), stale_pos.size());
+        out.longs(stale_ver.data(), stale_ver.size());
+        out.floats(rows.data(), rows.size());
+        return 0;
+      }
+      case Op::kPushEmbedding: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t nidx, nval, nupd;
+        const int64_t* idx = rd.longs(&nidx);
+        const float* g = rd.floats(&nval);
+        const int64_t* upd = rd.longs(&nupd);  // per-row update counts
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        t->apply_sparse(idx, nidx, g);
+        if (!t->ver.empty())
+          for (size_t j = 0; j < nupd && j < nidx; ++j)
+            if (idx[j] >= 0 && idx[j] < t->len)
+              t->ver[idx[j]] += upd[j] - 1;  // apply_sparse added 1
+        return 0;
+      }
+      case Op::kPushSyncEmbedding: {
+        Tensor* t = get(id);
+        if (!t || t->ver.empty()) return -1;
+        int64_t bound = rd.i64();
+        size_t npidx, nval, nupd, nsidx, nsver;
+        const int64_t* pidx = rd.longs(&npidx);
+        const float* g = rd.floats(&nval);
+        const int64_t* upd = rd.longs(&nupd);
+        const int64_t* sidx = rd.longs(&nsidx);
+        const int64_t* sver = rd.longs(&nsver);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        t->apply_sparse(pidx, npidx, g);
+        for (size_t j = 0; j < nupd && j < npidx; ++j)
+          if (pidx[j] >= 0 && pidx[j] < t->len)
+            t->ver[pidx[j]] += upd[j] - 1;
+        std::vector<int64_t> stale_pos, stale_ver;
+        std::vector<float> rows;
+        for (size_t j = 0; j < nsidx; ++j) {
+          int64_t row = sidx[j];
+          if (row < 0 || row >= t->len) continue;
+          if (t->ver[row] - sver[j] > bound) {
+            stale_pos.push_back(static_cast<int64_t>(j));
+            stale_ver.push_back(t->ver[row]);
+            size_t o = rows.size();
+            rows.resize(o + t->width);
+            std::memcpy(rows.data() + o, t->data.data() + row * t->width,
+                        t->width * sizeof(float));
+          }
+        }
+        out.longs(stale_pos.data(), stale_pos.size());
+        out.longs(stale_ver.data(), stale_ver.size());
+        out.floats(rows.data(), rows.size());
+        return 0;
+      }
+      case Op::kParamSet: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        size_t n;
+        const float* p = rd.floats(&n);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        if (static_cast<int64_t>(n) != t->nelem()) return -3;
+        std::memcpy(t->data.data(), p, n * sizeof(float));
+        return 0;
+      }
+      case Op::kParamClear: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        std::fill(t->data.begin(), t->data.end(), 0.f);
+        return 0;
+      }
+      case Op::kParamSave: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        std::string path = rd.str();
+        std::shared_lock<std::shared_mutex> l(t->mu);
+        FILE* f = std::fopen(path.c_str(), "wb");
+        if (!f) return -2;
+        std::fwrite(&t->len, sizeof t->len, 1, f);
+        std::fwrite(&t->width, sizeof t->width, 1, f);
+        std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+        std::fclose(f);
+        return 0;
+      }
+      case Op::kParamLoad: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        std::string path = rd.str();
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        FILE* f = std::fopen(path.c_str(), "rb");
+        if (!f) return -2;
+        int64_t len, width;
+        if (std::fread(&len, sizeof len, 1, f) != 1 ||
+            std::fread(&width, sizeof width, 1, f) != 1 ||
+            len != t->len || width != t->width) {
+          std::fclose(f);
+          return -3;
+        }
+        size_t got = std::fread(t->data.data(), sizeof(float),
+                                t->data.size(), f);
+        std::fclose(f);
+        return got == t->data.size() ? 0 : -3;
+      }
+      case Op::kBarrier: {
+        std::unique_lock<std::mutex> l(bar_mu_);
+        int gen = bar_gen_;
+        if (++bar_count_ >= nworkers_) {
+          bar_count_ = 0;
+          ++bar_gen_;
+          bar_cv_.notify_all();
+        } else {
+          bar_cv_.wait(l, [&] { return bar_gen_ != gen; });
+        }
+        return 0;
+      }
+      case Op::kPushData: {
+        int64_t key = rd.i64();
+        size_t n;
+        const float* p = rd.floats(&n);
+        std::unique_lock<std::shared_mutex> l(blob_mu_);
+        blobs_[key].assign(p, p + n);
+        return 0;
+      }
+      case Op::kPullData: {
+        int64_t key = rd.i64();
+        std::shared_lock<std::shared_mutex> l(blob_mu_);
+        auto it = blobs_.find(key);
+        if (it == blobs_.end()) return -1;
+        out.floats(it->second.data(), it->second.size());
+        return 0;
+      }
+      case Op::kGetLoads: {
+        out.u64(bytes_in_.load());
+        return 0;
+      }
+      case Op::kShutdown:
+        return 0;
+    }
+    return -100;
+  }
+
+  int port_;
+  int nworkers_;
+  std::atomic<bool> stop_{false};
+  std::unordered_map<int32_t, std::unique_ptr<Tensor>> store_;
+  std::shared_mutex store_mu_;
+  std::unordered_map<int64_t, std::vector<float>> blobs_;
+  std::shared_mutex blob_mu_;
+  std::mutex bar_mu_;
+  std::condition_variable bar_cv_;
+  int bar_count_ = 0;
+  int bar_gen_ = 0;
+  std::atomic<uint64_t> bytes_in_{0};
+};
+
+}  // namespace hetups
+
+extern "C" int hetu_ps_run_server(int port, int nworkers) {
+  hetups::Server s(port, nworkers);
+  return s.run();
+}

@@ -1,0 +1,101 @@
+"""ctypes binding to libhetu_ps.so (reference parity: python/hetu/_base.py
+loading libc_runtime_api.so + the python_binding.cc C ABI).
+
+The shared object builds lazily from hetu_tpu/ps/native/ via make on first
+use — mirroring how the reference expects a prebuilt build/lib but staying
+self-contained.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libhetu_ps.so")
+_lib = None
+
+
+def build_lib():
+    sources = ["ps_server.cc", "ps_client.cc", "ps_common.h", "Makefile"]
+    newest = max(os.path.getmtime(os.path.join(_NATIVE_DIR, s))
+                 for s in sources)
+    if not os.path.exists(_SO_PATH) or \
+            os.path.getmtime(_SO_PATH) < newest:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+    return _SO_PATH
+
+
+def get_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_lib())
+
+    i64 = ctypes.c_int64
+    fp = ctypes.POINTER(ctypes.c_float)
+    lp = ctypes.POINTER(ctypes.c_int64)
+
+    lib.PSInit.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                           ctypes.c_int]
+    lib.PSInit.restype = ctypes.c_int
+    lib.PSFinalize.argtypes = []
+    lib.InitTensor.argtypes = [ctypes.c_int, ctypes.c_int, i64, i64,
+                               ctypes.c_int, ctypes.c_double,
+                               ctypes.c_double, ctypes.c_uint64,
+                               ctypes.c_int, fp, ctypes.c_int]
+    lib.InitTensor.restype = ctypes.c_int
+    lib.Pull.argtypes = [ctypes.c_int, fp, i64]
+    lib.Pull.restype = ctypes.c_int
+    lib.Push.argtypes = [ctypes.c_int, fp, i64]
+    lib.DDPushPull.argtypes = [ctypes.c_int, fp, fp, i64]
+    lib.SparsePush.argtypes = [ctypes.c_int, lp, fp, i64, i64]
+    lib.SparsePull.argtypes = [ctypes.c_int, lp, fp, i64, i64]
+    lib.SparsePull.restype = ctypes.c_int
+    lib.SDPushPull.argtypes = [ctypes.c_int, lp, fp, i64, fp, i64, i64]
+    lib.SSPushPull.argtypes = [ctypes.c_int, lp, fp, i64, lp, i64, fp, i64]
+    lib.SyncEmbedding.argtypes = [ctypes.c_int, i64, lp, lp, i64, fp, i64]
+    lib.SyncEmbedding.restype = ctypes.c_int
+    lib.PushEmbedding.argtypes = [ctypes.c_int, lp, fp, lp, i64, i64]
+    lib.Wait.argtypes = [ctypes.c_int]
+    lib.WaitAll.argtypes = []
+    lib.BarrierWorker.argtypes = []
+    lib.SetParam.argtypes = [ctypes.c_int, fp, i64]
+    lib.SetParam.restype = ctypes.c_int
+    lib.Clear.argtypes = [ctypes.c_int]
+    lib.Clear.restype = ctypes.c_int
+    lib.SaveParam.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.SaveParam.restype = ctypes.c_int
+    lib.LoadParam.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.LoadParam.restype = ctypes.c_int
+    lib.PushData.argtypes = [i64, fp, i64]
+    lib.PushData.restype = ctypes.c_int
+    lib.PullData.argtypes = [i64, fp, i64]
+    lib.PullData.restype = ctypes.c_int
+    lib.GetLoads.argtypes = []
+    lib.GetLoads.restype = ctypes.c_uint64
+    lib.ShutdownServers.argtypes = []
+    lib.hetu_ps_run_server.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hetu_ps_run_server.restype = ctypes.c_int
+
+    _lib = lib
+    return lib
+
+
+def as_f32(arr):
+    return np.ascontiguousarray(arr, dtype=np.float32)
+
+
+def as_i64(arr):
+    return np.ascontiguousarray(arr, dtype=np.int64)
+
+
+def fptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def lptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))

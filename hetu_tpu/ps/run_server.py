@@ -1,0 +1,15 @@
+"""Server-role entrypoint: ``python -m hetu_tpu.ps.run_server PORT
+NWORKERS`` (the reference's DMLC_ROLE=server process)."""
+import sys
+
+from .native_lib import get_lib
+
+
+def main():
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 18590
+    nworkers = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    sys.exit(get_lib().hetu_ps_run_server(port, nworkers))
+
+
+if __name__ == "__main__":
+    main()

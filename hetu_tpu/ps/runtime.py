@@ -1,19 +1,228 @@
-"""Executor-side PS runtime — scheduling of host push/pull ops between
-compiled segments. Implemented with the C++ parameter server milestone."""
+"""Executor-side PS runtime: schedules host push/pull around the compiled
+step (reference parity: the d2h-stream PS path of SubExecutor,
+executor.py:1800-1825, and ParameterServerCommunicateOp's
+_compute_asp_prefetch, ParameterServerCommunicate.py:38-70).
+
+Per step:
+  1. sparse-pull the embedding rows this batch needs (the lookup node
+     becomes a feed of the jit step — the reference's prefetch ps_map),
+  2. run the compiled step; PS-managed grads come back as extra outputs,
+  3. dense grads -> DDPushPull (server-side optimizer) and the returned
+     value replaces the HBM param; sparse grads -> SparsePush,
+  4. optional BSP barrier.
+"""
 from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..ndarray import IndexedSlices
+
+
+def _opt_spec(optimizer):
+    """(server opt name, lrs[]) from a worker optimizer instance."""
+    name = optimizer.name
+    lr = float(optimizer.learning_rate)
+    if name == "SGD":
+        return "SGD", [lr]
+    if name == "Momentum":
+        kind = "Nesterov" if getattr(optimizer, "nesterov", False) \
+            else "Momentum"
+        return kind, [lr, float(optimizer.momentum)]
+    if name == "AdaGrad":
+        return "AdaGrad", [lr, float(optimizer.eps)]
+    if name in ("Adam", "AdamW"):
+        # lrs[4] (if present) is decoupled weight decay, applied by the
+        # server's Adam after the moment update
+        lrs = [lr, float(optimizer.beta1), float(optimizer.beta2),
+               float(optimizer.epsilon)]
+        if name == "AdamW":
+            lrs.append(float(optimizer.weight_decay))
+        return "Adam", lrs
+    return "SGD", [lr]
 
 
 class PSRuntime:
     def __init__(self, executor, config):
-        raise RuntimeError(
-            "PS runtime requested but the C++ parameter server is not "
-            "built yet; PS/Hybrid modes land with hetu_tpu/ps/native")
+        self.executor = executor
+        self.config = config
+        self.client = config.ps_comm
+        self.registered = set()
+        self.caches = {}        # param.id -> CacheSparseTable
+        # eager registration so save()/load() work before the first step
+        self._register_all()
 
-    def run_step(self, subexecutor, feed_dict, convert):
-        raise NotImplementedError
+    # ------------------------------------------------------------------
+    def _register_all(self):
+        fresh = False
+        for op in self.config.ps_nodes:
+            if not hasattr(op, "parameter"):
+                continue
+            if self._register_one(op):
+                fresh = True
+        if fresh and self.config.bsp:
+            self.client.barrier()
 
+    def _register_one(self, op):
+        """Register one PS-managed parameter on the server; returns True
+        when it was newly registered."""
+        opt = getattr(op, "optimizer_info", None)
+        opt_name, lrs = _opt_spec(opt) if opt is not None else ("SGD", [0.1])
+        param = op.parameter
+        if param.id in self.registered:
+            return False
+        tid = param.id
+        shape = tuple(param.shape)
+        if param.is_embed:
+            kind = 2 if self.config.cstable_policy else 1
+            init = None
+            if param.initializer is not None:
+                init = param.initializer.dist_spec()
+            if init is not None:
+                # on-server init: the table never materializes on the
+                # worker (trillion-parameter scaling path)
+                self.client.init_tensor(
+                    tid, shape, kind=kind, init=init,
+                    seed=self.config.seed + param.id, opt=opt_name,
+                    lrs=lrs)
+            else:
+                self.client.init_tensor(tid, shape, kind=kind,
+                                        opt=opt_name, lrs=lrs)
+                self.client.set_param(tid, param.initial_value(
+                    seed=self.config.seed))
+            if self.config.cstable_policy:
+                from ..cstable import CacheSparseTable
+                bound = self.config.cache_bound
+                self.caches[param.id] = CacheSparseTable(
+                    tid, shape[0], int(np.prod(shape[1:])),
+                    limit=max(1, shape[0] // 5),
+                    policy=self.config.cstable_policy,
+                    pull_bound=bound, push_bound=bound)
+        else:
+            self.client.init_tensor(tid, shape, kind=0, opt=opt_name,
+                                    lrs=lrs)
+            sid = str(param.id)
+            value = self.executor.params.get(sid)
+            if value is None:
+                value = param.initial_value(seed=self.config.seed)
+            self.client.set_param(tid, np.asarray(value))
+        self.registered.add(param.id)
+        return True
+
+    # ------------------------------------------------------------------
+    def run_step(self, sub, feed_dict, convert_to_numpy_ret_vals=False):
+        executor = self.executor
+        client = self.client
+        nworkers = max(1, client.nworkers)
+        feed_dict = feed_dict or {}
+
+        feed_map = {}
+        for node, value in feed_dict.items():
+            feed_map[node] = sub._ingest(value)
+        for dl in sub.dataloader_ops:
+            feed_map[dl] = sub._ingest(dl.get_arr(sub.name))
+
+        # 1. embedding rows for this batch (reference SparsePull /
+        # prefetch path, EmbeddingLookUp.py:27-40)
+        for lk in sub.ps_lookups:
+            index_node = lk.inputs[1]
+            if index_node in feed_map:
+                idx = np.asarray(jax.device_get(feed_map[index_node]))
+            else:
+                raise RuntimeError(
+                    "PS embedding lookup requires its indices to be a "
+                    "feed or dataloader output")
+            width = int(lk.inputs[0].shape[-1])
+            cache = self.caches.get(lk.inputs[0].id)
+            if cache is not None:
+                rows = cache.embedding_lookup(idx)
+            else:
+                rows = client.sparse_pull(lk.inputs[0].id, idx, width)
+            feed_map[lk] = jax.device_put(rows)
+        # explicit sparse-pull ops (inference path, reference
+        # ParameterServerCommunicate.py:236-288) feed the same way
+        for op in sub.ps_pull_ops:
+            index_node = op.inputs[0]
+            if index_node not in feed_map:
+                raise RuntimeError("PS sparse pull requires its indices "
+                                   "to be a feed or dataloader output")
+            idx = np.asarray(jax.device_get(feed_map[index_node]))
+            width = int(op.parameter.shape[-1])
+            rows = client.sparse_pull(op.parameter.id, idx, width)
+            feed_map[op] = jax.device_put(rows)
+
+        key = sub._shape_key(feed_map)
+        if key not in sub.compiled:
+            sub._infer_shapes(feed_map)
+            sub._ensure_state(executor)
+            sub.compiled[key] = sub._compile_step()
+        fn = sub.compiled[key]
+        outputs, new_params, new_state, new_opt, ps_grads = fn(
+            *sub.trace_args(executor, feed_map))
+        if sub.training:
+            executor.params = new_params
+            executor.state = new_state
+            executor.opt_state = new_opt
+            for opt in sub.optimizer_ops:
+                opt.optimizer.lr_sched.step()
+        sub.step_count += 1
+
+        # 3. push PS grads / pull updated params
+        for op, g in zip(sub.ps_ops, ps_grads):
+            param = op.parameter
+            tid = param.id
+            if isinstance(g, IndexedSlices):
+                width = int(param.shape[-1])
+                idx = np.asarray(jax.device_get(g.indices)).ravel()
+                vals = np.asarray(jax.device_get(g.values)).reshape(
+                    idx.size, width)
+                if nworkers > 1:
+                    vals = vals / nworkers
+                cache = self.caches.get(param.id)
+                if cache is not None:
+                    cache.embedding_update(idx, vals)
+                else:
+                    client.sparse_push(tid, idx, vals, width)
+                    client.wait(tid)
+            else:
+                grad = np.asarray(jax.device_get(g)).ravel()
+                if nworkers > 1:
+                    grad = grad / nworkers
+                new_value = client.dd_pushpull(tid, grad)
+                client.wait(tid)
+                sid = str(param.id)
+                if sid in executor.params:
+                    executor.params[sid] = jax.device_put(
+                        new_value.reshape(param.shape))
+
+        # 4. synchronization discipline: BSP barrier or ASP free-running
+        # (reference ParameterServerCommunicate.py:226-231)
+        if self.config.bsp:
+            client.barrier()
+
+        results = []
+        from .. import ndarray as nd
+        for out in outputs:
+            if out is None:
+                results.append(None)
+            elif convert_to_numpy_ret_vals:
+                results.append(np.asarray(out))
+            else:
+                results.append(nd.NDArray(out, None))
+        return results
+
+    # ------------------------------------------------------------------
     def save(self, path):
-        raise NotImplementedError
+        import os
+        for cache in self.caches.values():
+            cache.flush()       # pending grads reach the server first
+        for op_param_id in sorted(self.registered):
+            self.client.save_param(
+                op_param_id, os.path.join(path, f"ps_{op_param_id}.bin"))
 
     def load(self, path):
-        raise NotImplementedError
+        import os
+        for op_param_id in sorted(self.registered):
+            self.client.load_param(
+                op_param_id, os.path.join(path, f"ps_{op_param_id}.bin"))

@@ -1,22 +1,82 @@
-"""PS server process management — implemented with the C++ parameter
-server in the PS milestone; these stubs fail loudly until then."""
+"""PS server / scheduler process management.
+
+Reference parity: python/hetu/launcher.py forks scheduler/server/worker
+roles from a yaml config, wiring DMLC_* env vars. Here the server is the
+C++ ``hetu_ps_run_server`` loop launched as a subprocess; addressing is
+direct (env HETU_PS_HOSTS/HETU_PS_PORTS) so no scheduler rendezvous
+process is needed — ensure_scheduler is kept as an API no-op.
+"""
 from __future__ import annotations
 
-_NOT_READY = ("the C++ parameter server is not built yet; PS/Hybrid "
-              "communication modes land with hetu_tpu/ps/native")
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_server_procs = []
 
 
-def ensure_scheduler():
-    raise RuntimeError(_NOT_READY)
+def default_port():
+    return int(os.environ.get("HETU_PS_PORTS", "18590").split(",")[0])
 
 
-def shutdown_scheduler():
-    pass
+def _port_open(host, port):
+    try:
+        with socket.create_connection((host, port), timeout=0.2):
+            return True
+    except OSError:
+        return False
 
 
-def ensure_server():
-    raise RuntimeError(_NOT_READY)
+def pick_free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def ensure_server(port=None, nworkers=None, wait_s=10.0):
+    """Start a PS server subprocess on ``port`` if none is listening."""
+    port = port or default_port()
+    nworkers = nworkers or int(os.environ.get("HETU_PS_NWORKERS", "1"))
+    if _port_open("127.0.0.1", port):
+        return None
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pypath = pkg_root + os.pathsep + os.environ.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hetu_tpu.ps.run_server", str(port),
+         str(nworkers)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pypath})
+    _server_procs.append(proc)
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        if _port_open("127.0.0.1", port):
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"PS server exited with {proc.returncode} during startup")
+        time.sleep(0.05)
+    raise RuntimeError(f"PS server did not come up on :{port}")
 
 
 def shutdown_server():
+    for proc in _server_procs:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    _server_procs.clear()
+
+
+def ensure_scheduler():
+    """Direct-addressed transport needs no rendezvous scheduler; kept for
+    reference API parity (launcher.py scheduler role)."""
+
+
+def shutdown_scheduler():
     pass

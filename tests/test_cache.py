@@ -1,0 +1,123 @@
+"""Embedding-cache tests (reference strategy:
+tests/hetu_cache/hetu_cache_test.py exercising CacheSparseTable staleness
+and the Hybrid/cache CTR path)."""
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+from hetu_tpu.ps import server as ps_server
+from hetu_tpu.ps import client as ps_client
+from hetu_tpu.cstable import CacheSparseTable
+
+
+@pytest.fixture(scope="module")
+def ps():
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    yield client
+    client.shutdown_servers()
+    ps_client.close_default_client()
+    ps_server.shutdown_server()
+
+
+@pytest.mark.parametrize("policy", ["LRU", "LFU", "LFUOpt"])
+def test_cache_lookup_update_flush(ps, policy):
+    tid = 2000 + {"LRU": 0, "LFU": 1, "LFUOpt": 2}[policy]
+    ps.init_tensor(tid, (20, 4), kind=2, opt="SGD", lrs=[1.0])
+    table = np.arange(80, dtype=np.float32).reshape(20, 4)
+    ps.set_param(tid, table)
+
+    cache = CacheSparseTable(tid, 20, 4, limit=8, policy=policy,
+                             pull_bound=0, push_bound=100)
+    got = cache.embedding_lookup(np.array([0, 3, 7]))
+    np.testing.assert_allclose(got, table[[0, 3, 7]])
+    assert cache.perf["misses"] == 3
+
+    # repeat lookup hits the cache (no server change => no pulls)
+    got = cache.embedding_lookup(np.array([0, 3, 7]))
+    np.testing.assert_allclose(got, table[[0, 3, 7]])
+    assert cache.perf["hits"] == 3
+
+    # local grad accumulates; flush applies on server (SGD lr=1)
+    cache.embedding_update(np.array([0]), np.ones((1, 4), np.float32))
+    cache.flush()
+    np.testing.assert_allclose(
+        ps.sparse_pull(tid, np.array([0]), 4)[0], table[0] - 1.0)
+    # after flush our cached version is stale; pull_bound=0 re-pulls
+    got = cache.embedding_lookup(np.array([0]))
+    np.testing.assert_allclose(got[0], table[0] - 1.0)
+
+
+def test_cache_eviction_pushes_pending(ps):
+    tid = 2100
+    ps.init_tensor(tid, (50, 2), kind=2, opt="SGD", lrs=[1.0])
+    ps.set_param(tid, np.zeros((50, 2), np.float32))
+    cache = CacheSparseTable(tid, 50, 2, limit=4, policy="LRU",
+                             pull_bound=0, push_bound=100)
+    cache.embedding_lookup(np.array([0, 1, 2, 3]))
+    cache.embedding_update(np.array([0]), np.ones((1, 2), np.float32))
+    # touching 4 new keys evicts key 0 -> its pending grad must flush
+    cache.embedding_lookup(np.array([4, 5, 6, 7]))
+    ps.wait(tid)
+    np.testing.assert_allclose(
+        ps.sparse_pull(tid, np.array([0]), 2)[0], [-1, -1])
+    assert cache.perf["evicts"] >= 4
+
+
+def test_cache_staleness_bound(ps):
+    tid = 2200
+    ps.init_tensor(tid, (10, 2), kind=2, opt="None")
+    ps.set_param(tid, np.zeros((10, 2), np.float32))
+    cache = CacheSparseTable(tid, 10, 2, limit=10, policy="LFU",
+                             pull_bound=2, push_bound=100)
+    cache.embedding_lookup(np.array([1]))
+    # another writer bumps row 1 once: within bound (2), cache stays stale
+    ps.sparse_push(tid, np.array([1]), np.ones((1, 2), np.float32), 2)
+    ps.wait(tid)
+    np.testing.assert_allclose(cache.embedding_lookup(np.array([1]))[0],
+                               [0, 0])
+    # two more bumps exceed the bound -> refresh
+    for _ in range(2):
+        ps.sparse_push(tid, np.array([1]), np.ones((1, 2), np.float32), 2)
+    ps.wait(tid)
+    np.testing.assert_allclose(cache.embedding_lookup(np.array([1]))[0],
+                               [3, 3])
+
+
+def test_cached_ctr_training(ps):
+    """End-to-end: PS mode with cstable_policy trains and converges."""
+    rng = np.random.RandomState(0)
+    emb_val = rng.randn(40, 8).astype("f") * 0.1
+    dense = ht.Variable("dense", trainable=False)
+    sparse = ht.Variable("sparse", trainable=False)
+    y_ = ht.Variable("y_", trainable=False)
+    emb = ht.Variable("cache_embedding", value=emb_val)
+    w = ht.Variable("cache_w",
+                    value=rng.randn(8 * 4 + 5, 1).astype("f") * 0.1)
+    look = ht.embedding_lookup_op(emb, sparse)
+    flat = ht.array_reshape_op(look, (-1, 8 * 4))
+    feats = ht.concat_op(flat, dense, axis=1)
+    y = ht.sigmoid_op(ht.matmul_op(feats, w))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+    train_op = ht.optim.SGDOptimizer(learning_rate=0.3).minimize(loss)
+    exe = Executor([loss, train_op], ctx=ht.tpu(0), comm_mode="PS",
+                   cstable_policy="LFUOpt", cache_bound=0)
+    d = rng.randn(16, 5).astype("f")
+    s = rng.randint(0, 40, (16, 4))
+    yv = rng.randint(0, 2, (16, 1)).astype("f")
+    losses = []
+    for _ in range(8):
+        losses.append(exe.run(feed_dict={dense: d, sparse: s, y_: yv}
+                              )[0].asnumpy().item())
+    assert losses[-1] < losses[0], losses
+    rt = exe.ps_runtime
+    assert rt.caches, "cache table was not created"
+    cache = next(iter(rt.caches.values()))
+    assert cache.perf["hits"] + cache.perf["misses"] > 0

@@ -39,7 +39,7 @@ def test_logreg():
 
 
 def test_mlp():
-    losses = _train(models.mlp, (8, 3072))
+    losses = _train(models.mlp, (8, 3072), lr=0.01)
     assert losses[-1] < losses[0]
 
 

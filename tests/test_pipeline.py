@@ -1,0 +1,102 @@
+"""Pipeline parallelism: GPipe must be loss-equivalent to single-device
+full-batch training; PipeDream (1F1B) must match sequential-microbatch
+training when depth allows (reference strategy:
+examples/runner/parallel/{gpipe,pipedream}.py + validate_results.py)."""
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+
+
+def _weights(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w1": rng.randn(20, 32).astype("f") * 0.2,
+        "b1": np.zeros(32, "f"),
+        "w2": rng.randn(32, 24).astype("f") * 0.2,
+        "w3": rng.randn(24, 10).astype("f") * 0.2,
+    }
+
+
+def _data(n=64, seed=1):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 20).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return x, y
+
+
+def _build(weights, staged):
+    """2-stage MLP: stage0 = fc1 on cpu:0, stage1 = fc2+fc3+loss on cpu:1
+    (reference gpipe.py assigns layer blocks with `with ht.context`)."""
+    ctx0 = ht.cpu(0) if staged else None
+    ctx1 = ht.cpu(1) if staged else None
+
+    def scope(c):
+        return ht.context(c) if c is not None else ht.context(ht.cpu(0))
+
+    with scope(ctx0):
+        x = ht.Variable("x", trainable=False)
+        w1 = ht.Variable("w1", value=weights["w1"])
+        b1 = ht.Variable("b1", value=weights["b1"])
+        act = ht.matmul_op(x, w1)
+        act = ht.relu_op(act + ht.broadcastto_op(b1, act))
+    with scope(ctx1):
+        w2 = ht.Variable("w2", value=weights["w2"])
+        w3 = ht.Variable("w3", value=weights["w3"])
+        act2 = ht.relu_op(ht.matmul_op(act, w2))
+        logits = ht.matmul_op(act2, w3)
+        y_ = ht.Variable("y_", trainable=False)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(logits, y_), [0])
+        train_op = ht.optim.SGDOptimizer(learning_rate=0.2).minimize(loss)
+    return x, y_, loss, train_op
+
+
+def _run(exe, x, y_, xs, ys, steps, bs=32):
+    out = []
+    for i in range(steps):
+        s = (i * bs) % len(xs)
+        res = exe.run(feed_dict={x: xs[s:s + bs], y_: ys[s:s + bs]})
+        out.append(float(np.asarray(res[0].asnumpy()).reshape(()).item()))
+    return np.asarray(out)
+
+
+def test_gpipe_matches_single_device():
+    weights = _weights()
+    xs, ys = _data()
+    x, y_, loss, train_op = _build(weights, staged=False)
+    base_exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    base = _run(base_exe, x, y_, xs, ys, steps=6)
+
+    x, y_, loss, train_op = _build(weights, staged=True)
+    pipe_exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
+    assert len(pipe_exe.subexecutors["default"].stages) == 2
+    pipe = _run(pipe_exe, x, y_, xs, ys, steps=6)
+    # gpipe reports mean of per-microbatch losses == full-batch mean loss
+    np.testing.assert_allclose(pipe, base, rtol=2e-4, atol=1e-5)
+
+
+def test_pipedream_runs_and_converges():
+    weights = _weights(2)
+    xs, ys = _data(64, 3)
+    x, y_, loss, train_op = _build(weights, staged=True)
+    exe = Executor([loss, train_op], pipedream=True, num_microbatches=4)
+    sub = exe.subexecutors["default"]
+    assert sub.schedule == "1f1b" and len(sub.stages) == 2
+    losses = _run(exe, x, y_, xs, ys, steps=8)
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipedream_weight_stashing_semantics():
+    """With 1 microbatch, 1F1B degenerates to sequential training and must
+    exactly match the plain executor on the same microbatch size."""
+    weights = _weights(4)
+    xs, ys = _data(32, 5)
+    x, y_, loss, train_op = _build(weights, staged=False)
+    base_exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    base = _run(base_exe, x, y_, xs, ys, steps=5, bs=16)
+
+    x, y_, loss, train_op = _build(weights, staged=True)
+    exe = Executor([loss, train_op], pipedream=True, num_microbatches=1)
+    pd = _run(exe, x, y_, xs, ys, steps=5, bs=16)
+    np.testing.assert_allclose(pd, base, rtol=2e-4, atol=1e-5)

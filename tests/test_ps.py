@@ -1,0 +1,189 @@
+"""Parameter-server tests (reference strategy: tests/pstests/test_apis.py —
+multi-role simulated on localhost, asserting push/pull/init semantics —
+plus PS-vs-local loss-trajectory equivalence)."""
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+from hetu_tpu.ps import server as ps_server
+from hetu_tpu.ps import client as ps_client
+
+
+@pytest.fixture(scope="module")
+def ps():
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    yield client
+    client.shutdown_servers()
+    ps_client.close_default_client()
+    ps_server.shutdown_server()
+
+
+def test_dense_push_pull(ps):
+    ps.init_tensor(1001, (8, 4), kind=0, opt="None")
+    val = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ps.set_param(1001, val)
+    np.testing.assert_allclose(ps.pull(1001, (8, 4)), val)
+    # OptKind None: push accumulates (worker pre-scaled grads)
+    ps.push(1001, np.ones((8, 4), np.float32))
+    ps.wait(1001)
+    np.testing.assert_allclose(ps.pull(1001, (8, 4)), val + 1)
+
+
+def test_dense_server_sgd(ps):
+    ps.init_tensor(1002, (4,), kind=0, opt="SGD", lrs=[0.5])
+    ps.set_param(1002, np.zeros(4, np.float32))
+    out = ps.dd_pushpull(1002, np.ones(4, np.float32))
+    ps.wait(1002)
+    np.testing.assert_allclose(out, -0.5 * np.ones(4))
+
+
+def test_sparse_ops(ps):
+    ps.init_tensor(1003, (10, 3), kind=1, opt="None")
+    ps.set_param(1003, np.zeros((10, 3), np.float32))
+    idx = np.array([2, 5, 2])
+    vals = np.ones((3, 3), np.float32)
+    ps.sparse_push(1003, idx, vals, width=3)
+    ps.wait(1003)
+    got = ps.sparse_pull(1003, np.array([2, 5, 0]), width=3)
+    np.testing.assert_allclose(got[0], 2 * np.ones(3))   # row 2 hit twice
+    np.testing.assert_allclose(got[1], np.ones(3))
+    np.testing.assert_allclose(got[2], np.zeros(3))
+
+
+def test_ss_pushpull_prefetch(ps):
+    ps.init_tensor(1004, (6, 2), kind=1, opt="None")
+    ps.set_param(1004, np.tile(np.arange(6, dtype=np.float32)[:, None],
+                               (1, 2)))
+    out = ps.ss_pushpull(1004, np.array([0]),
+                         10 * np.ones((1, 2), np.float32),
+                         np.array([0, 3]), width=2)
+    ps.wait(1004)
+    np.testing.assert_allclose(out[0], [10, 10])   # pushed then pulled
+    np.testing.assert_allclose(out[1], [3, 3])
+
+
+def test_on_server_init_and_save_load(ps, tmp_path):
+    ps.init_tensor(1005, (100, 8), kind=1, init=(2, 0.0, 1.0), seed=7,
+                   opt="None")
+    rows = ps.sparse_pull(1005, np.arange(100), width=8)
+    assert 0.5 < rows.std() < 1.5 and abs(rows.mean()) < 0.3
+    path = str(tmp_path / "t1005.bin")
+    ps.save_param(1005, path)
+    ps.clear(1005)
+    assert ps.pull(1005, (100, 8)).std() == 0
+    ps.load_param(1005, path)
+    np.testing.assert_allclose(ps.pull(1005, (100, 8)), rows.reshape(100, 8))
+
+
+def test_bounded_staleness_sync(ps):
+    """reference hetu_client.cc:6-38: pull only rows whose server version
+    advanced beyond the client's by more than the bound."""
+    ps.init_tensor(1006, (5, 2), kind=2, opt="None")   # CacheTable
+    ps.set_param(1006, np.zeros((5, 2), np.float32))
+    cache = np.zeros((3, 2), np.float32)
+    versions = np.zeros(3, np.int64)
+    idx = np.array([0, 1, 2])
+    # no server updates yet: nothing stale
+    assert ps.sync_embedding(1006, 0, idx, versions, cache, 2) == 0
+    # update rows 0,1 on the server (bumps versions)
+    ps.sparse_push(1006, np.array([0, 1]), np.ones((2, 2), np.float32), 2)
+    ps.wait(1006)
+    # bound=0: both advanced rows refresh
+    n = ps.sync_embedding(1006, 0, idx, versions, cache, 2)
+    assert n == 2
+    np.testing.assert_allclose(cache[0], [1, 1])
+    np.testing.assert_allclose(versions, [1, 1, 0])
+    # bound=1 tolerates one staleness step: another push, no refresh needed
+    ps.sparse_push(1006, np.array([0]), np.ones((1, 2), np.float32), 2)
+    ps.wait(1006)
+    assert ps.sync_embedding(1006, 1, idx, versions, cache, 2) == 0
+    # bound=0 forces it
+    assert ps.sync_embedding(1006, 0, idx, versions, cache, 2) == 1
+    np.testing.assert_allclose(cache[0], [2, 2])
+
+
+def test_barrier_single_worker(ps):
+    ps.barrier()     # nworkers=1: returns immediately
+
+
+def test_data_blobs(ps):
+    ps.push_data(42, np.arange(5, dtype=np.float32))
+    np.testing.assert_allclose(ps.pull_data(42, 5), np.arange(5))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end PS training
+# ---------------------------------------------------------------------------
+
+def _ctr_graph(seed):
+    rng = np.random.RandomState(seed)
+    emb_val = rng.randn(50, 8).astype("f") * 0.1
+    w_val = rng.randn(8 * 4 + 5, 1).astype("f") * 0.1
+    dense = ht.Variable("dense", trainable=False)
+    sparse = ht.Variable("sparse", trainable=False)
+    y_ = ht.Variable("y_", trainable=False)
+    emb = ht.Variable("ctr_embedding", value=emb_val)
+    w = ht.Variable("ctr_w", value=w_val)
+    look = ht.embedding_lookup_op(emb, sparse)
+    flat = ht.array_reshape_op(look, (-1, 8 * 4))
+    feats = ht.concat_op(flat, dense, axis=1)
+    y = ht.sigmoid_op(ht.matmul_op(feats, w))
+    loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+    train_op = ht.optim.SGDOptimizer(learning_rate=0.5).minimize(loss)
+    return dense, sparse, y_, loss, train_op
+
+
+def _ctr_feeds(rng):
+    return (rng.randn(16, 5).astype("f"),
+            rng.randint(0, 50, (16, 4)),
+            rng.randint(0, 2, (16, 1)).astype("f"))
+
+
+def test_ps_training_matches_local(ps):
+    # local ground truth
+    dense, sparse, y_, loss, train_op = _ctr_graph(0)
+    exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    rng = np.random.RandomState(1)
+    feeds = [_ctr_feeds(rng) for _ in range(6)]
+    base = []
+    for d, s, y in feeds:
+        base.append(exe.run(feed_dict={dense: d, sparse: s, y_: y}
+                            )[0].asnumpy().item())
+
+    # PS mode: every trainable routes through the server
+    dense, sparse, y_, loss, train_op = _ctr_graph(0)
+    exe_ps = Executor([loss, train_op], ctx=ht.tpu(0), comm_mode="PS")
+    sub = exe_ps.subexecutors["default"]
+    assert len(sub.ps_ops) == 2 and len(sub.ps_lookups) == 1
+    # embedding table must NOT be materialized on the worker
+    names = [exe_ps._param_nodes[k].name for k in exe_ps.params]
+    assert "ctr_embedding" not in names
+    got = []
+    for d, s, y in feeds:
+        got.append(exe_ps.run(feed_dict={dense: d, sparse: s, y_: y}
+                              )[0].asnumpy().item())
+    np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
+
+
+def test_ps_save_load(ps, tmp_path):
+    dense, sparse, y_, loss, train_op = _ctr_graph(3)
+    exe = Executor([loss, train_op], ctx=ht.tpu(0), comm_mode="PS")
+    rng = np.random.RandomState(4)
+    d, s, y = _ctr_feeds(rng)
+    for _ in range(2):
+        exe.run(feed_dict={dense: d, sparse: s, y_: y})
+    exe.save(str(tmp_path))
+    before = exe.run(feed_dict={dense: d, sparse: s, y_: y}
+                     )[0].asnumpy().item()
+    exe.load(str(tmp_path))
+    after = exe.run(feed_dict={dense: d, sparse: s, y_: y}
+                    )[0].asnumpy().item()
+    assert np.isfinite(before) and np.isfinite(after)

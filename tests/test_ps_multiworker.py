@@ -1,0 +1,48 @@
+"""Multi-worker PS semantics on localhost (reference
+tests/pstests/test_apis.py: scheduler/server/worker processes forked
+locally, results asserted via shared memory)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+from hetu_tpu.ps import server as ps_server
+
+
+def _worker(rank, nworkers, port, results):
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    from hetu_tpu.ps.client import PSClient
+    client = PSClient(rank=rank, nworkers=nworkers)
+    tid = 3000
+    client.init_tensor(tid, (4,), kind=0, opt="None")   # first init wins
+    client.barrier()
+    if rank == 0:
+        client.set_param(tid, np.zeros(4, np.float32))
+    client.barrier()
+    # every worker pushes rank+1; after barrier all see the sum
+    client.push(tid, np.full(4, rank + 1, np.float32))
+    client.wait(tid)
+    client.barrier()
+    out = client.pull(tid, (4,))
+    results[rank] = float(out[0])
+    client.barrier()
+    client.close()
+
+
+def test_two_workers_push_pull_barrier():
+    port = ps_server.pick_free_port()
+    proc = ps_server.ensure_server(port=port, nworkers=2)
+    assert proc is not None
+    ctx = mp.get_context("spawn")
+    with ctx.Manager() as mgr:
+        results = mgr.dict()
+        ps_ = [ctx.Process(target=_worker, args=(r, 2, port, results))
+               for r in range(2)]
+        for p in ps_:
+            p.start()
+        for p in ps_:
+            p.join(timeout=50)
+            assert p.exitcode == 0
+        # 1 + 2 pushed onto zeros
+        assert results[0] == results[1] == 3.0
+    ps_server.shutdown_server()
