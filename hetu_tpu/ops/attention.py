@@ -57,14 +57,16 @@ class FlashAttentionOp(Op):
     def compute(self, input_vals, ectx):
         q, k, v = input_vals[:3]
         mask = input_vals[3] if self.has_mask else None
+        if _use_pallas():
+            # causal is a kernel flag; only the padding mask travels
+            from .pallas_attention import flash_attention
+            return flash_attention(q, k, v, mask, sm_scale=self.sm_scale,
+                                   causal=self.causal)
         if self.causal:
             s = q.shape[-2]
             cmask = jnp.where(
                 jnp.tril(jnp.ones((s, s), bool)), 0.0, -1e9)[None, None]
             mask = cmask if mask is None else mask + cmask
-        if _use_pallas():
-            from .pallas_attention import flash_attention
-            return flash_attention(q, k, v, mask, self.sm_scale)
         return attention_reference(q, k, v, mask, self.sm_scale)
 
     def gradient(self, output_grad):

@@ -1,0 +1,147 @@
+"""Pallas TPU flash-attention forward kernel.
+
+No reference equivalent (the reference composes attention from cublas
+batch-matmuls, examples/nlp/bert/hetu_bert.py:191-227). This is the
+blocked online-softmax kernel: per (batch*head, q-block) program, stream
+K/V blocks through VMEM keeping a running (max, sum, accumulator) — the
+[S, S] score matrix never exists in HBM, so attention memory is O(S·D)
+instead of O(S²) and the MXU stays fed from VMEM.
+
+Backward currently rematerializes through the composed-XLA reference
+(ops/attention.py _FlashAttentionGradOp) — the standard recompute
+policy; a fused backward kernel is a later optimization.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale,
+                block_k, seq_len, causal, block_q):
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    num_kb = seq_len // block_k
+    qi = pl.program_id(1)
+    if causal:
+        # skip K-blocks strictly in the future of this q-block
+        num_kb = jnp.minimum(
+            num_kb, pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0, pl.ds(i * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _block_sizes(seq_len, head_dim):
+    bq = min(256, seq_len)
+    while seq_len % bq:
+        bq //= 2
+    bk = min(512, seq_len)
+    while seq_len % bk:
+        bk //= 2
+    return max(bq, 8), max(bk, 8)
+
+
+def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
+                    interpret=None):
+    """softmax(q k^T * sm_scale + mask) v over [B, H, S, D].
+
+    ``mask`` is an additive *padding* mask broadcastable to [B, 1, 1, S]
+    (the BERT layout); causal masking is a kernel flag, not a mask
+    argument. Tiny or oddly-shaped inputs fall back to the composed-XLA
+    reference rather than violating TPU tiling constraints.
+    """
+    if interpret is None:
+        interpret = INTERPRET
+    b, h, s, d = q.shape
+    if s < 8 or d % 8:
+        from .attention import attention_reference
+        m = mask
+        if causal:
+            cmask = jnp.where(jnp.tril(jnp.ones((s, s), bool)), 0.0,
+                              NEG_INF)[None, None]
+            m = cmask if m is None else m + cmask
+        return attention_reference(q, k, v, m, sm_scale)
+    return _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret)
+
+
+# tests flip this to exercise the kernel without a TPU backend
+INTERPRET = False
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "causal",
+                                             "interpret"))
+def _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret):
+    b, h, s, d = q.shape
+    block_q, block_k = _block_sizes(s, d)
+    grid = (b * h, s // block_q)
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    args = [qr, kr, vr]
+    if mask is not None:
+        mr = jnp.broadcast_to(mask, (b, 1, 1, s)).reshape(
+            b, 1, s).astype(jnp.float32)
+        in_specs.append(
+            pl.BlockSpec((1, 1, s), lambda bh, qi, _h=h: (bh // _h, 0, 0)))
+        args.append(mr)
+        kernel = functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=s,
+            causal=causal, block_q=block_q)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref):
+            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref,
+                        sm_scale=sm_scale, block_k=block_k, seq_len=s,
+                        causal=causal, block_q=block_q)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, s, d)

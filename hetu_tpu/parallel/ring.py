@@ -1,0 +1,92 @@
+"""Ring attention — sequence/context parallelism over an ICI ring.
+
+NEW capability, absent in the reference (SURVEY.md §5: no sequence
+parallelism anywhere; BERT caps at 512 tokens). The sequence axis shards
+over a mesh axis; each device keeps its Q shard resident and rotates K/V
+shards around the ring with ``lax.ppermute`` while merging partial
+attention with the online-softmax rule — the distributed form of flash
+attention. Peak memory per chip is O(S/n · D) and the KV transfers ride
+ICI neighbor links, overlapping with the block matmuls.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
+    """Per-shard ring attention body (call inside shard_map).
+
+    q, k, v: local shards [B, H, S_local, D] (sequence dim sharded over
+    ``axis_name``). mask: optional additive [B, 1, 1, S_local] shard.
+    Non-causal (bidirectional-encoder semantics).
+    """
+    axis_size = lax.psum(1, axis_name)
+
+    def partial_attn(q_, k_, v_, mask_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if mask_ is not None:
+            s = s + mask_
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_.dtype), v_)
+        return m, l, o.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, carry):
+        m_acc, l_acc, o_acc, k_cur, v_cur, mask_cur = carry
+        m_blk, l_blk, o_blk = partial_attn(q, k_cur, v_cur, mask_cur)
+        m_new = jnp.maximum(m_acc, m_blk)
+        a_old = jnp.exp(m_acc - m_new)
+        a_blk = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a_old + l_blk * a_blk
+        o_new = o_acc * a_old + o_blk * a_blk
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = (lax.ppermute(mask_cur, axis_name, perm)
+                    if mask_cur is not None else None)
+        return m_new, l_new, o_new, k_nxt, v_nxt, mask_nxt
+
+    b, h, s_loc, d = q.shape
+    m0 = jnp.full((b, h, s_loc, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    carry = (m0, l0, o0, k, v, mask)
+    # static python loop: axis_size rotations; each iteration's ppermute
+    # overlaps with the next block's matmuls under XLA latency hiding
+    for i in range(axis_size):
+        carry = step(i, carry)
+    _, l, o = carry[0], carry[1], carry[2]
+    return (o / l).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
+                           mask=None):
+    """shard_map wrapper: q/k/v are global [B, H, S, D]; the sequence dim
+    shards over ``axis_name`` of ``mesh`` and the ring runs over ICI."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:                   # older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    mask_spec = P(None, None, None, axis_name)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           sm_scale=sm_scale)
+    if mask is not None:
+        body = lambda q_, k_, v_, m_: fn(q_, k_, v_, mask=m_)  # noqa: E731
+        return shard_map(body, mesh=mesh,
+                         in_specs=(spec, spec, spec, mask_spec),
+                         out_specs=spec)(q, k, v, mask)
+    body = lambda q_, k_, v_: fn(q_, k_, v_)                   # noqa: E731
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
