@@ -1,0 +1,4 @@
+"""Tokenizers (reference parity: python/hetu/tokenizers/)."""
+from .bert_tokenizer import (BertTokenizer, BasicTokenizer,
+                             WordpieceTokenizer, load_vocab,
+                             whitespace_tokenize)

@@ -1,0 +1,207 @@
+"""BERT WordPiece tokenizer (reference parity:
+python/hetu/tokenizers/bert_tokenizer.py — same public surface:
+``BertTokenizer`` with ``tokenize`` / ``convert_tokens_to_ids`` /
+``convert_ids_to_tokens``, composed from ``BasicTokenizer`` (cleanup,
+lower-casing, accent stripping, punctuation/CJK splitting) and
+``WordpieceTokenizer`` (greedy longest-match-first subwords)).
+
+Pure Python, no downloads: vocabularies load from a local ``vocab.txt``
+(one token per line, id = line number).
+"""
+from __future__ import annotations
+
+import collections
+import unicodedata
+
+__all__ = ["BertTokenizer", "BasicTokenizer", "WordpieceTokenizer",
+           "load_vocab", "whitespace_tokenize"]
+
+
+def load_vocab(vocab_file):
+    """token -> id dict from a one-token-per-line file."""
+    vocab = collections.OrderedDict()
+    with open(vocab_file, encoding="utf-8") as f:
+        for index, line in enumerate(f):
+            token = line.rstrip("\n")
+            if token:
+                vocab[token] = index
+    return vocab
+
+
+def whitespace_tokenize(text):
+    text = text.strip()
+    return text.split() if text else []
+
+
+def _is_whitespace(char):
+    if char in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(char) == "Zs"
+
+
+def _is_control(char):
+    if char in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(char).startswith("C")
+
+
+def _is_punctuation(char):
+    cp = ord(char)
+    # ASCII non-alphanumerics count as punctuation (so "foo-bar" splits)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(char).startswith("P")
+
+
+def _is_chinese_char(cp):
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation splitting with unicode cleanup."""
+
+    def __init__(self, do_lower_case=True,
+                 never_split=("[UNK]", "[SEP]", "[PAD]", "[CLS]",
+                              "[MASK]")):
+        self.do_lower_case = do_lower_case
+        self.never_split = set(never_split)
+
+    def tokenize(self, text):
+        text = self._clean_text(text)
+        text = self._pad_chinese_chars(text)
+        tokens = []
+        for token in whitespace_tokenize(text):
+            if token in self.never_split:
+                tokens.append(token)
+                continue
+            if self.do_lower_case:
+                token = self._strip_accents(token.lower())
+            tokens.extend(self._split_on_punc(token))
+        return whitespace_tokenize(" ".join(tokens))
+
+    def _clean_text(self, text):
+        out = []
+        for char in text:
+            cp = ord(char)
+            if cp == 0 or cp == 0xFFFD or _is_control(char):
+                continue
+            out.append(" " if _is_whitespace(char) else char)
+        return "".join(out)
+
+    def _pad_chinese_chars(self, text):
+        out = []
+        for char in text:
+            if _is_chinese_char(ord(char)):
+                out.extend((" ", char, " "))
+            else:
+                out.append(char)
+        return "".join(out)
+
+    def _strip_accents(self, text):
+        text = unicodedata.normalize("NFD", text)
+        return "".join(c for c in text
+                       if unicodedata.category(c) != "Mn")
+
+    def _split_on_punc(self, text):
+        out = [[]]
+        for char in text:
+            if _is_punctuation(char):
+                out.append([char])
+                out.append([])
+            else:
+                out[-1].append(char)
+        return ["".join(x) for x in out if x]
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword split against a vocab."""
+
+    def __init__(self, vocab, unk_token="[UNK]",
+                 max_input_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, text):
+        output = []
+        for token in whitespace_tokenize(text):
+            chars = list(token)
+            if len(chars) > self.max_input_chars_per_word:
+                output.append(self.unk_token)
+                continue
+            start = 0
+            pieces = []
+            bad = False
+            while start < len(chars):
+                end = len(chars)
+                cur = None
+                while start < end:
+                    piece = "".join(chars[start:end])
+                    if start > 0:
+                        piece = "##" + piece
+                    if piece in self.vocab:
+                        cur = piece
+                        break
+                    end -= 1
+                if cur is None:
+                    bad = True
+                    break
+                pieces.append(cur)
+                start = end
+            output.extend([self.unk_token] if bad else pieces)
+        return output
+
+
+class BertTokenizer:
+    """End-to-end BERT tokenizer (reference bert_tokenizer.py:76-158)."""
+
+    def __init__(self, vocab_file=None, vocab=None, do_lower_case=True,
+                 max_len=None, do_basic_tokenize=True,
+                 never_split=("[UNK]", "[SEP]", "[PAD]", "[CLS]",
+                              "[MASK]")):
+        if vocab is None:
+            assert vocab_file is not None, "need vocab_file or vocab"
+            vocab = load_vocab(vocab_file)
+        self.vocab = vocab
+        self.ids_to_tokens = {v: k for k, v in vocab.items()}
+        self.do_basic_tokenize = do_basic_tokenize
+        if do_basic_tokenize:
+            self.basic_tokenizer = BasicTokenizer(
+                do_lower_case=do_lower_case, never_split=never_split)
+        self.wordpiece_tokenizer = WordpieceTokenizer(vocab=vocab)
+        self.max_len = max_len if max_len is not None else int(1e12)
+
+    def tokenize(self, text):
+        if self.do_basic_tokenize:
+            split = []
+            for token in self.basic_tokenizer.tokenize(text):
+                split.extend(self.wordpiece_tokenizer.tokenize(token))
+            return split
+        return self.wordpiece_tokenizer.tokenize(text)
+
+    def convert_tokens_to_ids(self, tokens):
+        ids = [self.vocab.get(t, self.vocab.get("[UNK]", 0))
+               for t in tokens]
+        if len(ids) > self.max_len:
+            raise ValueError(
+                f"sequence length {len(ids)} > model max {self.max_len}")
+        return ids
+
+    def convert_ids_to_tokens(self, ids):
+        return [self.ids_to_tokens[i] for i in ids]
+
+    def encode(self, text):
+        return self.convert_tokens_to_ids(self.tokenize(text))
+
+    @classmethod
+    def from_pretrained(cls, vocab_path, **kwargs):
+        """Load from a local vocab.txt path or directory containing one
+        (no network access — the reference downloads from S3)."""
+        import os
+        if os.path.isdir(vocab_path):
+            vocab_path = os.path.join(vocab_path, "vocab.txt")
+        return cls(vocab_file=vocab_path, **kwargs)
