@@ -194,6 +194,11 @@ class BertSelfAttention:
         v = self._heads(self.value(hidden_states, shape3), seq_len)
 
         if self.use_flash:
+            # NOTE: the fused kernel keeps attention probs in VMEM and
+            # does not implement probs-dropout; attention_probs_dropout
+            # is therefore skipped on this path (dropout on the output
+            # projection still applies). This matches the usual flash
+            # implementations and diverges from the composed path.
             from ..ops.attention import flash_attention_op
             context = flash_attention_op(q, k, v, attention_mask,
                                          sm_scale=1.0 / float(

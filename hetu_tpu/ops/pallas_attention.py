@@ -89,7 +89,11 @@ def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
     if interpret is None:
         interpret = INTERPRET
     b, h, s, d = q.shape
-    if s < 8 or d % 8:
+    block_q, block_k = _block_sizes(s, d)
+    # the grid covers s // block only when s divides evenly; max(bq, 8)
+    # can break that for s % 8 != 0 (e.g. s=260), which would leave tail
+    # rows unwritten — fall back to the composed reference instead
+    if s < 8 or d % 8 or s % block_q or s % block_k:
         from .attention import attention_reference
         m = mask
         if causal:

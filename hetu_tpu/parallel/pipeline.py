@@ -299,8 +299,12 @@ class PipelineSubExecutor:
                     stage.params, stage_ins[(m, stage.index)],
                     feeds[stage.index][m], rngs[m], cots)
                 for node, d in zip(stage.in_nodes, dins):
-                    cot_map[(m, node)] = jax.device_put(
+                    # a boundary node feeding several later stages gets one
+                    # cotangent per consumer — sum them, don't overwrite
+                    d = jax.device_put(
                         d, self.stages[self.assign[node]].device)
+                    prev = cot_map.get((m, node))
+                    cot_map[(m, node)] = d if prev is None else prev + d
                 if grads[stage.index] is None:
                     grads[stage.index] = dparams
                 else:
@@ -347,8 +351,10 @@ class PipelineSubExecutor:
                     stash[m][stage.index], stage_ins[(m, stage.index)],
                     feeds[stage.index][m], rngs[m], cots)
                 for node, d in zip(stage.in_nodes, dins):
-                    cot_map[(m, node)] = jax.device_put(
+                    d = jax.device_put(
                         d, self.stages[self.assign[node]].device)
+                    prev = cot_map.get((m, node))
+                    cot_map[(m, node)] = d if prev is None else prev + d
                 grads[stage.index] = dparams
             del stash[m]
             self._apply(executor, grads)

@@ -141,48 +141,99 @@ struct Tensor {
     }
   }
 
-  // sparse row update (reference ApplySparse/ApplyCache); bumps versions
+  // one row's optimizer update from an (already aggregated) gradient
+  inline void apply_row(int64_t row, const float* src, float a) {
+    const int64_t w = width;
+    float* dst = data.data() + row * w;
+    switch (opt) {
+      case OptKind::kNone:
+        for (int64_t k = 0; k < w; ++k) dst[k] += src[k];
+        break;
+      case OptKind::kSGD:
+        for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
+        break;
+      case OptKind::kAdaGrad: {
+        float* acc = m.data() + row * w;
+        for (int64_t k = 0; k < w; ++k) {
+          acc[k] += src[k] * src[k];
+          dst[k] -= a * src[k] / (std::sqrt(acc[k]) + lrs[1]);
+        }
+        break;
+      }
+      case OptKind::kAdam: {
+        // row-wise adam without global bias correction (matches the
+        // reference's AdamOptimizer::ApplySparse per-row treatment)
+        const float b1 = lrs[1], b2 = lrs[2], eps = lrs[3];
+        float* mi = m.data() + row * w;
+        float* vi = v.data() + row * w;
+        for (int64_t k = 0; k < w; ++k) {
+          mi[k] = b1 * mi[k] + (1 - b1) * src[k];
+          vi[k] = b2 * vi[k] + (1 - b2) * src[k] * src[k];
+          dst[k] -= a * mi[k] / (std::sqrt(vi[k]) + eps);
+        }
+        break;
+      }
+      default:  // Momentum variants fall back to SGD row update
+        for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
+    }
+  }
+
+  // sparse row update (reference ApplySparse/ApplyCache); bumps versions.
+  // Duplicate row ids within one push are aggregated (summed) first so the
+  // parallel apply touches each row exactly once — otherwise two omp
+  // threads race on the same row's data/slots/version (lost updates).
+  // Versions advance by occurrence count, matching the cache push path
+  // (kPushEmbedding), so bounded-staleness accounting stays consistent.
   void apply_sparse(const int64_t* idx, size_t nidx, const float* g) {
     const int64_t w = width;
     const float a = lr();
-#pragma omp parallel for
+    // cheap duplicate scan first: the common cache-drained push has all
+    // unique ids, where we can apply straight from g with no copy
+    std::unordered_map<int64_t, int64_t> occ;  // row -> occurrence count
+    occ.reserve(nidx * 2);
+    bool has_dup = false;
     for (size_t j = 0; j < nidx; ++j) {
       int64_t row = idx[j];
       if (row < 0 || row >= len) continue;
-      float* dst = data.data() + row * w;
-      const float* src = g + j * w;
-      switch (opt) {
-        case OptKind::kNone:
-          for (int64_t k = 0; k < w; ++k) dst[k] += src[k];
-          break;
-        case OptKind::kSGD:
-          for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
-          break;
-        case OptKind::kAdaGrad: {
-          float* acc = m.data() + row * w;
-          for (int64_t k = 0; k < w; ++k) {
-            acc[k] += src[k] * src[k];
-            dst[k] -= a * src[k] / (std::sqrt(acc[k]) + lrs[1]);
-          }
-          break;
-        }
-        case OptKind::kAdam: {
-          // row-wise adam without global bias correction (matches the
-          // reference's AdamOptimizer::ApplySparse per-row treatment)
-          const float b1 = lrs[1], b2 = lrs[2], eps = lrs[3];
-          float* mi = m.data() + row * w;
-          float* vi = v.data() + row * w;
-          for (int64_t k = 0; k < w; ++k) {
-            mi[k] = b1 * mi[k] + (1 - b1) * src[k];
-            vi[k] = b2 * vi[k] + (1 - b2) * src[k] * src[k];
-            dst[k] -= a * mi[k] / (std::sqrt(vi[k]) + eps);
-          }
-          break;
-        }
-        default:  // Momentum variants fall back to SGD row update
-          for (int64_t k = 0; k < w; ++k) dst[k] -= a * src[k];
+      if (++occ[row] > 1) has_dup = true;
+    }
+    if (!has_dup) {
+      const int64_t n = static_cast<int64_t>(nidx);
+#pragma omp parallel for
+      for (int64_t j = 0; j < n; ++j) {
+        int64_t row = idx[j];
+        if (row < 0 || row >= len) continue;
+        apply_row(row, g + j * w, a);
+        if (!ver.empty()) ++ver[row];
       }
-      if (!ver.empty()) ++ver[row];
+      return;
+    }
+    std::unordered_map<int64_t, size_t> slot;  // row -> index into uniq
+    slot.reserve(occ.size() * 2);
+    std::vector<int64_t> uniq_rows;
+    std::vector<float> agg;  // aggregated gradients, uniq-major
+    uniq_rows.reserve(occ.size());
+    agg.reserve(occ.size() * w);
+    for (size_t j = 0; j < nidx; ++j) {
+      int64_t row = idx[j];
+      if (row < 0 || row >= len) continue;
+      const float* src = g + j * w;
+      auto it = slot.find(row);
+      if (it == slot.end()) {
+        slot.emplace(row, uniq_rows.size());
+        uniq_rows.push_back(row);
+        agg.insert(agg.end(), src, src + w);
+      } else {
+        float* acc = agg.data() + it->second * w;
+        for (int64_t k = 0; k < w; ++k) acc[k] += src[k];
+      }
+    }
+    const int64_t nuniq = static_cast<int64_t>(uniq_rows.size());
+#pragma omp parallel for
+    for (int64_t j = 0; j < nuniq; ++j) {
+      int64_t row = uniq_rows[j];
+      apply_row(row, agg.data() + j * w, a);
+      if (!ver.empty()) ver[row] += occ[row];
     }
   }
 

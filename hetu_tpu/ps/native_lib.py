@@ -19,7 +19,8 @@ _lib = None
 
 
 def build_lib():
-    sources = ["ps_server.cc", "ps_client.cc", "ps_common.h", "Makefile"]
+    sources = ["ps_server.cc", "ps_client.cc", "ps_cache.cc",
+               "ps_common.h", "Makefile"]
     newest = max(os.path.getmtime(os.path.join(_NATIVE_DIR, s))
                  for s in sources)
     if not os.path.exists(_SO_PATH) or \

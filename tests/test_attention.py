@@ -152,3 +152,13 @@ def test_flash_attention_tiny_seq_fallback():
     out = flash_attention(q, k, v, None, sm_scale=0.5, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_odd_seq_falls_back():
+    # s=260: block sizing would leave tail rows unwritten in the kernel;
+    # must route to the composed reference and stay correct
+    q, k, v = _qkv(b=1, h=2, s=260, d=16, seed=3)
+    ref = attention_reference(q, k, v, None, 0.25)
+    out = flash_attention(q, k, v, None, sm_scale=0.25, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

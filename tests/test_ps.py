@@ -187,3 +187,18 @@ def test_ps_save_load(ps, tmp_path):
     after = exe.run(feed_dict={dense: d, sparse: s, y_: y}
                     )[0].asnumpy().item()
     assert np.isfinite(before) and np.isfinite(after)
+
+
+def test_sparse_push_duplicate_rows_sgd(ps):
+    # regression: duplicate row ids in one push must aggregate exactly
+    # (the omp loop used to race on the shared row)
+    ps.init_tensor(1010, (16, 4), kind=1, opt="SGD", lrs=[1.0])
+    ps.set_param(1010, np.zeros((16, 4), np.float32))
+    idx = np.array([3] * 64 + [7] * 32, dtype=np.int64)
+    vals = np.ones((96, 4), np.float32)
+    ps.sparse_push(1010, idx, vals, width=4)
+    ps.wait(1010)
+    got = ps.sparse_pull(1010, np.array([3, 7, 0]), width=4)
+    np.testing.assert_allclose(got[0], -64 * np.ones(4))
+    np.testing.assert_allclose(got[1], -32 * np.ones(4))
+    np.testing.assert_allclose(got[2], np.zeros(4))
