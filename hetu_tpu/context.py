@@ -245,7 +245,11 @@ class NodeStatus:
         assert self._device_num == len(devices), \
             f"status wants {self._device_num} devices, got {len(devices)}"
 
-    # -- device-index algebra (kept for parity tests) -----------------------
+    # -- device-index algebra ----------------------------------------------
+    # Verified against jax.sharding.NamedSharding's device->shard map in
+    # tests/test_parallel.py::test_order_algebra_matches_named_sharding:
+    # a mesh whose axes follow ``order`` (major->minor) places shards on
+    # exactly the devices this algebra predicts.
     def map_dev_to_index(self, global_index):
         """Which shard coordinates the global_index-th device holds."""
         coords = [0] * len(self._state)
@@ -345,7 +349,9 @@ def get_launch_config_by_traverse_nodes(node_list, default_ctx):
             for ctx in raw:
                 devices.update(ctx if isinstance(ctx, tuple) else (ctx,))
             local_nrank = raw.worker_num
-            assert local_nrank in (0, nrank), \
+            # nrank == 0: single-process SPMD (e.g. a PP+TP pipeline whose
+            # stages are device tuples) — there is no worker fleet to match
+            assert nrank == 0 or local_nrank in (0, nrank), \
                 f"inconsistent worker counts: ({local_nrank}, {nrank})"
         for n in node.inputs:
             visit(n)

@@ -122,8 +122,12 @@ class HetuConfig:
         # -- TP planner (reference assign_context_by_traverse_nodes) ----
         self.node_spec = {}
         self.model_axes = {}
-        from .parallel.planner import assign_states
-        assign_states(eval_node_list, self)
+        if not (self.use_gpipe or self.use_pipedream):
+            # pipeline mode plans per stage (PipelineSubExecutor
+            #._plan_stage_tp) — a global mesh here would be dead weight
+            # that leaks into stage traces
+            from .parallel.planner import assign_states
+            assign_states(eval_node_list, self)
         if self.comm_mode in ("PS", "Hybrid") or self.ps_nodes:
             from .ps.client import get_default_client
             self.ps_comm = get_default_client()

@@ -141,6 +141,36 @@ class BatchMatMulOp(Op):
             f"batch dims mismatch {a} x {b}"
         return tuple(a[:-1]) + (b[-1],)
 
+    def deduce_states(self, input_statuses, status, deduce_order):
+        """Batch dims pass through; m from A, n from B, matching k-splits
+        contract into the duplicate axis (reference BatchMatrixMult.py's
+        per-dim table, same shape algebra as MatMulOp over trailing dims).
+        """
+        lA, lB = input_statuses
+        tA, tB = self.trans_A, self.trans_B
+
+        def trail(st, trans):
+            if st is None or st.state is None or len(st.state) < 2:
+                return None, None, ()
+            s = st.state
+            batch = s[:-2]
+            r, c = s[-2], s[-1]
+            return ((c, r) if trans else (r, c)) + (batch,)
+
+        a_row, a_col, a_batch = trail(lA, tA)
+        b_row, b_col, b_batch = trail(lB, tB)
+        if a_row is None and b_row is None:
+            return
+        batch = a_batch if a_batch else b_batch
+        m = a_row if a_row is not None else 1
+        n = b_col if b_col is not None else 1
+        k = a_col if a_col is not None else (b_row or 1)
+        if not deduce_order:
+            status.set_state(tuple(batch) + (m, n))
+            dup = max(lA.duplicate or 1 if lA else 1,
+                      lB.duplicate or 1 if lB else 1) * (k or 1)
+            status.set_attr(dup, (-1,) + tuple(range(len(batch) + 2)))
+
 
 def matmul_op(node_A, node_B, trans_A=False, trans_B=False, ctx=None):
     return MatMulOp(node_A, node_B, trans_A, trans_B, ctx=ctx)

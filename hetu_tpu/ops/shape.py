@@ -22,6 +22,50 @@ __all__ = [
 ]
 
 
+def _concat_deduce(input_statuses, status, deduce_order, axis):
+    """Shared concat rule: non-axis splits must agree across inputs (take
+    the first distributed one); the concat axis can't stay split (shard
+    boundaries interleave) — it folds into the duplicate axis."""
+    st = next((s for s in input_statuses
+               if s is not None and s.state is not None), None)
+    if st is None:
+        return
+    state = list(st.state)
+    folded = 1
+    if axis < len(state):
+        folded = state[axis]
+        state[axis] = 1
+    if not deduce_order:
+        status.set_state(tuple(state))
+        status.set_attr((st.duplicate or 1) * folded,
+                        (-1,) + tuple(range(len(state))))
+
+
+def _reduce_deduce(input_statuses, status, deduce_order, axes, keepdims):
+    """Shared reduce rule: splits on reduced axes become partial sums —
+    they fold into the duplicate axis (XLA inserts the psum); kept axes
+    carry their splits through (reference ReduceSum.py deduce_states)."""
+    st = input_statuses[0]
+    if st is None or st.state is None:
+        return
+    ndim = len(st.state)
+    ax_norm = [a if a >= 0 else a + ndim for a in axes]
+    state, folded = [], 1
+    for i, p in enumerate(st.state):
+        if i in ax_norm:
+            folded *= p
+            if keepdims[ax_norm.index(i)]:
+                state.append(1)
+        else:
+            state.append(p)
+    if not state:
+        state = [1]
+    if not deduce_order:
+        status.set_state(tuple(state))
+        status.set_attr((st.duplicate or 1) * folded,
+                        (-1,) + tuple(range(len(state))))
+
+
 class ArrayReshapeOp(Op):
     def __init__(self, node_A, output_shape, ctx=None):
         super().__init__(ArrayReshapeOp, [node_A], ctx)
@@ -48,6 +92,25 @@ class ArrayReshapeOp(Op):
             total = int(np.prod(input_shapes[0]))
             shape[shape.index(-1)] = total // known
         return tuple(shape)
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        """Only a leading-dim split survives a reshape for sure (the
+        reference Reshape.py likewise allows dim-0 splits only); other
+        splits fold into the duplicate axis so downstream ops still see
+        the parallelism degree.
+        """
+        st = input_statuses[0]
+        if st is None or st.state is None:
+            return
+        ndim = len(self.output_shape)
+        lead = st.state[0] if st.state else 1
+        rest = 1
+        for p in st.state[1:]:
+            rest *= p
+        if not deduce_order:
+            status.set_state((lead,) + (1,) * (ndim - 1))
+            status.set_attr((st.duplicate or 1) * rest,
+                            (-1,) + tuple(range(ndim)))
 
 
 class ArrayReshapeGradientOp(Op):
@@ -84,6 +147,16 @@ class BroadcastToOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[1]
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        # output has node_B's shape, so adopt node_B's partition state
+        st = input_statuses[1]
+        if st is None or st.state is None:
+            return
+        if not deduce_order:
+            status.set_state(st.state)
+            if st.duplicate is not None and st.order is not None:
+                status.set_attr(st.duplicate, st.order)
 
 
 class BroadcastShapeOp(Op):
@@ -129,6 +202,9 @@ class ConcatOp(Op):
         out = list(a)
         out[self.axis] = a[self.axis] + b[self.axis]
         return tuple(out)
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        _concat_deduce(input_statuses, status, deduce_order, self.axis)
 
 
 class ConcatGradientOp(Op):
@@ -179,6 +255,9 @@ class ConcatenateOp(Op):
         out = list(input_shapes[0])
         out[self.axis] = sum(s[self.axis] for s in input_shapes)
         return tuple(out)
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        _concat_deduce(input_statuses, status, deduce_order, self.axis)
 
 
 class ConcatenateGradientOp(Op):
@@ -234,6 +313,21 @@ class SplitOp(Op):
             assert out[ax] % spl == 0
             out[ax] //= spl
         return tuple(out)
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        # each output piece is a slice: splits on the sliced axes can't be
+        # carried (the shard boundary moved) — force them to 1
+        st = input_statuses[0]
+        if st is None or st.state is None:
+            return
+        state = list(st.state)
+        for ax in self.axes:
+            if ax < len(state):
+                state[ax] = 1
+        if not deduce_order:
+            status.set_state(tuple(state))
+            status.set_attr(st.duplicate or 1,
+                            (-1,) + tuple(range(len(state))))
 
 
 class SplitGradientOp(Op):
@@ -335,6 +429,20 @@ class TransposeOp(Op):
             else tuple(reversed(range(len(shape))))
         return tuple(shape[p] for p in perm)
 
+    def deduce_states(self, input_statuses, status, deduce_order):
+        # permute the split counts exactly like the dims (reference
+        # Transpose.py deduce_states)
+        st = input_statuses[0]
+        if st is None or st.state is None:
+            return
+        perm = self.perm if self.perm is not None \
+            else tuple(reversed(range(len(st.state))))
+        state = st.state + (1,) * (len(perm) - len(st.state))
+        if not deduce_order:
+            status.set_state(tuple(state[p] for p in perm))
+            status.set_attr(st.duplicate or 1,
+                            (-1,) + tuple(range(len(perm))))
+
 
 class PadOp(Op):
     def __init__(self, node_A, paddings, mode="CONSTANT", constant_values=0,
@@ -431,6 +539,10 @@ class ReduceSumOp(Op):
                 out.append(s)
         return tuple(out) if out else (1,)
 
+    def deduce_states(self, input_statuses, status, deduce_order):
+        _reduce_deduce(input_statuses, status, deduce_order,
+                       self.axes, self.keepdims)
+
 
 class ReduceMeanOp(Op):
     def __init__(self, node_A, axes, keepdims=False, ctx=None):
@@ -471,6 +583,10 @@ class ReduceMeanOp(Op):
             else:
                 out.append(s)
         return tuple(out) if out else (1,)
+
+    def deduce_states(self, input_statuses, status, deduce_order):
+        _reduce_deduce(input_statuses, status, deduce_order,
+                       self.axes, self.keepdims)
 
 
 class ReduceSumAxisZeroOp(Op):

@@ -37,12 +37,16 @@ __all__ = ["PipelineSubExecutor"]
 
 
 class _Stage:
-    __slots__ = ("index", "device", "nodes", "param_nodes", "feed_nodes",
+    __slots__ = ("index", "device", "devices", "mesh", "node_spec",
+                 "nodes", "param_nodes", "feed_nodes",
                  "in_nodes", "out_nodes", "fwd", "bwd", "params")
 
-    def __init__(self, index, device):
+    def __init__(self, index, device, devices=None):
         self.index = index
         self.device = device
+        self.devices = devices or [device]  # >1 => TP/DP inside the stage
+        self.mesh = None                    # per-stage mesh when sharded
+        self.node_spec = {}                 # node -> PartitionSpec
         self.nodes = []
         self.param_nodes = []
         self.feed_nodes = []
@@ -52,17 +56,45 @@ class _Stage:
         self.bwd = None
         self.params = {}
 
+    def put(self, val, spec=None):
+        """Move a value onto this stage: its single device, or its mesh
+        (replicated unless a spec is given)."""
+        if self.mesh is None:
+            return jax.device_put(val, self.device)
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(val, NamedSharding(
+            self.mesh, spec if spec is not None else PartitionSpec()))
+
+
+class _StageConfig:
+    """Config view a TP/DP stage traces under: the stage's own mesh and
+    spec table, everything else from the executor config (the composed
+    PP+TP mode of reference context.py:652-656 — equal-width stage groups,
+    each internally model-parallel)."""
+
+    def __init__(self, base, mesh, node_spec):
+        self._base = base
+        self.mesh = mesh
+        self.node_spec = node_spec
+
+    def spec_for(self, node):
+        return self.node_spec.get(node)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
 
 def _device_key(node):
     """Stage identity of a node from its raw_ctx (reference assigns stages
-    by `with ht.context(gpu(i))`, executor.py:496-506)."""
+    by `with ht.context(gpu(i))`; a tuple context means the stage's devices
+    cooperate on one model-parallel copy, context.py:652-656)."""
     ctx = node.raw_ctx
     if ctx is None or ctx.worker_num + ctx.server_num == 0:
         return None
     first = ctx[0]
     if isinstance(first, tuple):
-        first = first[0]
-    return (first.hostname, first.device_id)
+        return tuple((d.hostname, d.device_id) for d in first)
+    return ((first.hostname, first.device_id),)
 
 
 class PipelineSubExecutor:
@@ -104,12 +136,13 @@ class PipelineSubExecutor:
                     node, PlaceholderOp):
                 keys.append(k)
         if not keys:
-            keys = [("localhost", 0)]
+            keys = [(("localhost", 0),)]
         key_to_stage = {k: i for i, k in enumerate(keys)}
         nstages = len(keys)
-        stages = [
-            _Stage(i, devices[keys[i][1] % len(devices)])
-            for i in range(nstages)]
+        stages = []
+        for i in range(nstages):
+            devs = [devices[d[1] % len(devices)] for d in keys[i]]
+            stages.append(_Stage(i, devs[0], devs))
 
         assign = {}
         for node in topo:
@@ -153,6 +186,35 @@ class PipelineSubExecutor:
                 stages[s].out_nodes.append(ev)
         self.assign = assign
         self.stages = stages
+        self._plan_stage_tp(topo)
+
+    def _plan_stage_tp(self, topo):
+        """PP+TP / PP+DP composition: propagate NodeStatus over the whole
+        graph once, then build one mesh per multi-device stage and lower
+        that stage's statuses to PartitionSpecs over it (reference pairs
+        equal-width stage device groups the same way, context.py:652-656;
+        here XLA's SPMD partitioner supplies the in-stage collectives)."""
+        from .mesh import mesh_for_statuses
+        from .planner import propagate_statuses, spec_for_status
+
+        status = propagate_statuses(topo)
+        if not status:
+            return
+        for stage in self.stages:
+            if len(stage.devices) < 2:
+                continue
+            stage_nodes = set(stage.nodes) | set(stage.param_nodes)
+            sts = {n: st for n, st in status.items() if n in stage_nodes}
+            if not any(st is not None and st.is_dist()
+                       for st in sts.values()):
+                continue  # degenerate (1,1)-only stage: no mesh needed
+            mesh, model_axes = mesh_for_statuses(
+                sts.values(), devices=stage.devices)
+            stage.mesh = mesh
+            for node, st in sts.items():
+                spec = spec_for_status(st, model_axes)
+                if spec is not None:
+                    stage.node_spec[node] = spec
 
     # ------------------------------------------------------------------
     def _make_stage_fns(self, stage):
@@ -162,7 +224,11 @@ class PipelineSubExecutor:
         feed_order = list(stage.feed_nodes)
         in_order = list(stage.in_nodes)
         out_order = list(stage.out_nodes)
-        config = self.config
+        # Always trace under the stage's own mesh view (None for plain
+        # stages) — the executor's global mesh/spec table must not leak
+        # into a stage jit, or a dispatch in a single-device stage would
+        # be constrained onto foreign devices.
+        config = _StageConfig(self.config, stage.mesh, stage.node_spec)
 
         def stage_fn(params, boundary_in, feeds, rng):
             ectx = ExecContext(training=True, base_rng=rng, config=config)
@@ -199,7 +265,8 @@ class PipelineSubExecutor:
             for p in stage.param_nodes:
                 sid = str(p.id)
                 arr = executor.params[sid]
-                stage.params[sid] = jax.device_put(arr, stage.device)
+                # dispatched params store sharded over the stage mesh
+                stage.params[sid] = stage.put(arr, stage.node_spec.get(p))
             if stage.fwd is None:
                 self._make_stage_fns(stage)
 
@@ -216,8 +283,7 @@ class PipelineSubExecutor:
                     assert mb * m_total == v.shape[0], \
                         (f"batch {v.shape[0]} not divisible into "
                          f"{m_total} microbatches")
-                    vals.append(jax.device_put(
-                        v[m * mb:(m + 1) * mb], stage.device))
+                    vals.append(stage.put(v[m * mb:(m + 1) * mb]))
                 feeds_m.append(vals)
             per_stage.append(feeds_m)
         return per_stage
@@ -259,7 +325,7 @@ class PipelineSubExecutor:
             src_stage = self.assign[node]
             val = env_out[(m, src_stage)][
                 self.stages[src_stage].out_nodes.index(node)]
-            ins.append(jax.device_put(val, stage.device))
+            ins.append(stage.put(val))
         outs = stage.fwd(stage.params, ins, feeds[stage.index][m], rng)
         env_out[(m, stage.index)] = outs
         return ins
@@ -301,8 +367,7 @@ class PipelineSubExecutor:
                 for node, d in zip(stage.in_nodes, dins):
                     # a boundary node feeding several later stages gets one
                     # cotangent per consumer — sum them, don't overwrite
-                    d = jax.device_put(
-                        d, self.stages[self.assign[node]].device)
+                    d = self.stages[self.assign[node]].put(d)
                     prev = cot_map.get((m, node))
                     cot_map[(m, node)] = d if prev is None else prev + d
                 if grads[stage.index] is None:
@@ -351,8 +416,7 @@ class PipelineSubExecutor:
                     stash[m][stage.index], stage_ins[(m, stage.index)],
                     feeds[stage.index][m], rngs[m], cots)
                 for node, d in zip(stage.in_nodes, dins):
-                    d = jax.device_put(
-                        d, self.stages[self.assign[node]].device)
+                    d = self.stages[self.assign[node]].put(d)
                     prev = cot_map.get((m, node))
                     cot_map[(m, node)] = d if prev is None else prev + d
                 grads[stage.index] = dparams

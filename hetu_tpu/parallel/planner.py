@@ -15,12 +15,16 @@ construction, which the reference has to *test* for
 """
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from ..context import NodeStatus
 from .mesh import mesh_for_statuses
 
 __all__ = ["assign_states", "spec_for_status"]
+
+logger = logging.getLogger(__name__)
 
 
 def _prime_factors(n):
@@ -66,22 +70,20 @@ def spec_for_status(status, model_axes):
     return PartitionSpec(*spec)
 
 
-def assign_states(eval_node_list, config, sweeps=3):
-    """Seed statuses from DispatchOp markers, propagate through
-    ``deduce_states`` in topo order, build the mesh, assign specs.
+def propagate_statuses(topo, sweeps=3):
+    """Seed statuses from DispatchOp markers and propagate through
+    ``deduce_states`` in topo order to a fixpoint.
 
-    Fills ``config.node_status`` (node -> NodeStatus) and
-    ``config.node_spec`` (node -> PartitionSpec); sets ``config.mesh``
-    and ``config.model_axes`` when TP is present.
+    Returns the node -> NodeStatus dict (empty when no dispatch present).
+    Mesh-independent: callers lower the statuses to specs over whatever
+    mesh fits their device set (global for SPMD, per-stage for PP+TP).
     """
-    from ..graph.autodiff import find_topo_sort
     from ..ops.comm import DispatchOp, DispatchGradientOp
     from ..ops.variable import PlaceholderOp
 
-    topo = find_topo_sort(eval_node_list)
     dispatch_ops = [n for n in topo if isinstance(n, DispatchOp)]
     if not dispatch_ops:
-        return False
+        return {}
 
     status = {}
     for d in dispatch_ops:
@@ -109,7 +111,12 @@ def assign_states(eval_node_list, config, sweeps=3):
                 node.deduce_states(
                     [NodeStatus.from_other(s) if s is not None else None
                      for s in in_sts], st, False)
-            except Exception:
+            except Exception as e:
+                # the node stays unconstrained (numerics unaffected — XLA
+                # picks a layout) but a broken rule must not be silent
+                logger.warning(
+                    "deduce_states failed for %s (%s: %s); leaving the "
+                    "node unconstrained", node, type(e).__name__, e)
                 continue
             if st.state is None:
                 continue
@@ -126,6 +133,26 @@ def assign_states(eval_node_list, config, sweeps=3):
         if isinstance(node, DispatchGradientOp) and \
                 node.forward_input in status:
             status[node] = status[node.forward_input]
+    return status
+
+
+def assign_states(eval_node_list, config):
+    """Whole-graph planning for the SPMD executor: propagate statuses,
+    build the mesh, assign specs.
+
+    Fills ``config.node_status`` (node -> NodeStatus) and
+    ``config.node_spec`` (node -> PartitionSpec); sets ``config.mesh``
+    and ``config.model_axes`` when TP is present.
+    """
+    from ..graph.autodiff import find_topo_sort
+
+    topo = find_topo_sort(eval_node_list)
+    status = propagate_statuses(topo)
+    if not status or not any(
+            st is not None and st.is_dist() for st in status.values()):
+        # only degenerate (1,1) dispatches: nothing is actually split —
+        # an empty mesh would poison every constraint site
+        return False
 
     # mesh + specs
     dp = config.nrank if config.mesh is not None and \

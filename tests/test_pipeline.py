@@ -100,3 +100,66 @@ def test_pipedream_weight_stashing_semantics():
     exe = Executor([loss, train_op], pipedream=True, num_microbatches=1)
     pd = _run(exe, x, y_, xs, ys, steps=5, bs=16)
     np.testing.assert_allclose(pd, base, rtol=2e-4, atol=1e-5)
+
+
+def _build_tp(weights, staged):
+    """2 stages x 2 devices each: stage0 col-splits w1 over its pair (TP),
+    stage1 batch-splits its activations (DP) — the composed PP+TP/PP+DP
+    mode (reference context.py:652-656, test_mlp_mp_pp.py:57-135)."""
+    ctx0 = (ht.cpu(0), ht.cpu(1)) if staged else ht.cpu(0)
+    ctx1 = (ht.cpu(2), ht.cpu(3)) if staged else ht.cpu(0)
+
+    with ht.context(ctx0):
+        x = ht.Variable("x", trainable=False)
+        w1 = ht.Variable("w1", value=weights["w1"])
+        b1 = ht.Variable("b1", value=weights["b1"])
+        w1d = ht.dispatch(w1, (1, 2)) if staged else w1
+        act = ht.matmul_op(x, w1d)
+        act = ht.relu_op(act + ht.broadcastto_op(b1, act))
+        if staged:
+            act = ht.dispatch(act, (1, 1))
+    with ht.context(ctx1):
+        w2 = ht.Variable("w2", value=weights["w2"])
+        w3 = ht.Variable("w3", value=weights["w3"])
+        act = ht.dispatch(act, (2, 1)) if staged else act
+        act2 = ht.relu_op(ht.matmul_op(act, w2))
+        logits = ht.matmul_op(act2, w3)
+        y_ = ht.Variable("y_", trainable=False)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(logits, y_), [0])
+        train_op = ht.optim.SGDOptimizer(learning_rate=0.2).minimize(loss)
+    return x, y_, loss, train_op
+
+
+def test_gpipe_with_tp_and_dp_stages():
+    weights = _weights(7)
+    xs, ys = _data(64, 8)
+    x, y_, loss, train_op = _build_tp(weights, staged=False)
+    base_exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    base = _run(base_exe, x, y_, xs, ys, steps=6)
+
+    x, y_, loss, train_op = _build_tp(weights, staged=True)
+    exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
+    sub = exe.subexecutors["default"]
+    assert len(sub.stages) == 2
+    assert sub.stages[0].mesh is not None, "stage0 should have a TP mesh"
+    assert sub.stages[1].mesh is not None, "stage1 should have a DP mesh"
+    pipe = _run(exe, x, y_, xs, ys, steps=6)
+    np.testing.assert_allclose(pipe, base, rtol=2e-4, atol=1e-5)
+    # the dispatched w1 must be *stored* sharded over stage0's pair
+    w1_node = next(p for p in sub.stages[0].param_nodes if p.name == "w1")
+    arr = sub.stages[0].params[str(w1_node.id)]
+    assert len(arr.sharding.device_set) == 2
+
+
+def test_pipedream_with_tp_stage():
+    weights = _weights(9)
+    xs, ys = _data(64, 10)
+    x, y_, loss, train_op = _build_tp(weights, staged=False)
+    base_exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    base = _run(base_exe, x, y_, xs, ys, steps=5, bs=16)
+
+    x, y_, loss, train_op = _build_tp(weights, staged=True)
+    exe = Executor([loss, train_op], pipedream=True, num_microbatches=1)
+    pd = _run(exe, x, y_, xs, ys, steps=5, bs=16)
+    np.testing.assert_allclose(pd, base, rtol=2e-4, atol=1e-5)
