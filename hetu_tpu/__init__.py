@@ -26,6 +26,7 @@ from . import lr_scheduler as lr
 from . import initializers as init
 from . import data
 from . import metrics
+from . import launcher
 
 __version__ = "0.1.0"
 

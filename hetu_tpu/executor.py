@@ -46,7 +46,25 @@ __all__ = ["Executor", "HetuConfig", "SubExecutor", "gradients",
            "wrapped_mpi_nccl_init", "new_group_comm",
            "scheduler_init", "scheduler_finish", "worker_init",
            "worker_finish", "server_init", "server_finish",
-           "get_worker_communicate"]
+           "get_worker_communicate", "maybe_init_distributed"]
+
+_jax_distributed_initialized = False
+
+
+def maybe_init_distributed():
+    """Join the multi-host JAX job when the heturun launcher set the
+    coordinator env (reference: ps-lite rendezvous via the scheduler; on
+    TPU the analogue is jax.distributed — after it, jax.devices() spans
+    every host and XLA collectives ride ICI/DCN)."""
+    global _jax_distributed_initialized
+    if _jax_distributed_initialized or "HETU_COORDINATOR" not in os.environ:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=os.environ["HETU_COORDINATOR"],
+        num_processes=int(os.environ.get("HETU_NUM_PROCS", "1")),
+        process_id=int(os.environ.get("HETU_PROC_ID", "0")))
+    _jax_distributed_initialized = True
+    return True
 
 
 def _default_ctx():
@@ -73,6 +91,7 @@ class HetuConfig:
                  log_path=None, gpipe=False, pipedream=False,
                  dynamic_memory=False, mesh=None, dtype=None,
                  num_microbatches=None):
+        maybe_init_distributed()
         self.eval_node_list = eval_node_list
         self.train_name = train_name
         self.val_name = val_name

@@ -110,30 +110,108 @@ class Client {
       hp = he + 1;
       pp = pe + 1;
     }
-    // worker thread pool drains the async queue; detached so process
-    // teardown without PSFinalize can't terminate() on joinable threads
+    // worker thread pool drains the async queue; joinable so finalize()
+    // and the static destructor can stop them cleanly (a detached thread
+    // blocked on q_cv_ at process exit deadlocks interpreter teardown)
     for (int i = 0; i < 4; ++i)
-      std::thread([this] { this->worker_loop(); }).detach();
+      threads_.emplace_back([this] { this->worker_loop(); });
     return static_cast<int>(servers_.size());
   }
 
-  void finalize() {
+  void stop_threads() {
     {
       std::lock_guard<std::mutex> l(q_mu_);
       stopping_ = true;
       q_cv_.notify_all();
     }
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+  }
+
+  void finalize() {
+    stop_threads();
     for (auto& kv : pool_)
       for (auto& c : kv.second)
         if (c.ok()) ::close(c.fd);
     pool_.clear();
     servers_.clear();
+    {
+      std::lock_guard<std::mutex> l(parts_mu_);
+      parts_.clear();
+    }
   }
+
+  ~Client() { stop_threads(); }
 
   int server_of(int32_t tensor_id) const {
     return servers_.empty() ? 0
                             : tensor_id % static_cast<int>(servers_.size());
   }
+
+  // -- key-range partitioning (reference ps/partitioner.h Average/Block:
+  // one tensor's row range is split across the server fleet; the client
+  // splits each request by range and reassembles the responses) ---------
+  struct Part {
+    int64_t len = 0, width = 1;
+    std::vector<int64_t> offsets;  // nparts+1 row boundaries
+    std::vector<int> srv;          // server index per part
+    int nparts() const { return static_cast<int>(srv.size()); }
+    bool split() const { return srv.size() > 1; }
+    int part_of(int64_t row) const {
+      int lo = 0, hi = nparts() - 1;
+      while (lo < hi) {
+        int mid = (lo + hi + 1) / 2;
+        if (row >= offsets[mid]) lo = mid; else hi = mid - 1;
+      }
+      return lo;
+    }
+    int64_t rows_of(int p) const { return offsets[p + 1] - offsets[p]; }
+  };
+
+  // Average partition: rows spread evenly over every server (the
+  // trillion-parameter path — no single host needs the whole table).
+  // Tensors smaller than the fleet stay whole on their hashed server.
+  Part make_part(int32_t id, int64_t len, int64_t width) {
+    Part p;
+    p.len = len;
+    p.width = width;
+    int ns = static_cast<int>(servers_.size());
+    if (ns <= 1 || len < ns) {
+      p.offsets = {0, len};
+      p.srv = {server_of(id)};
+      return p;
+    }
+    int64_t base = len / ns, rem = len % ns, off = 0;
+    p.offsets.push_back(0);
+    for (int s = 0; s < ns; ++s) {
+      off += base + (s < rem ? 1 : 0);
+      p.offsets.push_back(off);
+      p.srv.push_back(s);
+    }
+    return p;
+  }
+
+  void register_part(int32_t id, const Part& p) {
+    std::lock_guard<std::mutex> l(parts_mu_);
+    parts_[id] = p;
+  }
+
+  Part part(int32_t id) {
+    {
+      std::lock_guard<std::mutex> l(parts_mu_);
+      auto it = parts_.find(id);
+      if (it != parts_.end()) return it->second;
+    }
+    // unknown tensor (registered by another worker process): whole-tensor
+    // placement on the hashed server, the pre-partitioning behavior
+    Part p;
+    p.offsets = {0, INT64_MAX};
+    p.srv = {server_of(id)};
+    return p;
+  }
+
+  int nservers() const { return static_cast<int>(servers_.size()); }
 
   // synchronous RPC
   int32_t call(int server, Op op, int32_t id, const Writer& req,
@@ -231,6 +309,8 @@ class Client {
   }
 
   std::mutex init_mu_;
+  std::unordered_map<int32_t, Part> parts_;
+  std::mutex parts_mu_;
   std::vector<std::pair<std::string, int>> servers_;
   std::unordered_map<int, std::vector<Conn>> pool_;
   std::mutex pool_mu_;
@@ -239,6 +319,7 @@ class Client {
   std::mutex q_mu_;
   std::condition_variable q_cv_;
   bool stopping_ = false;
+  std::vector<std::thread> threads_;
 
   std::unordered_map<int32_t, int> pending_;
   std::mutex pend_mu_;
@@ -270,58 +351,138 @@ void PSFinalize() { Client::Get().finalize(); }
 int PSRank() { return Client::Get().rank(); }
 int PSNumWorkers() { return Client::Get().nworkers(); }
 
+// Split sparse row ids by partition range: returns per-part local row ids
+// plus each entry's position in the original request (for reassembly).
+struct SparseRoute {
+  std::vector<std::vector<int64_t>> idx;   // per-part local row ids
+  std::vector<std::vector<size_t>> pos;    // per-part original positions
+};
+
+static SparseRoute route_sparse(const Client::Part& part, const int64_t* idx,
+                                int64_t nidx) {
+  SparseRoute r;
+  r.idx.resize(part.nparts());
+  r.pos.resize(part.nparts());
+  for (int64_t j = 0; j < nidx; ++j) {
+    int p = part.split() ? part.part_of(idx[j]) : 0;
+    r.idx[p].push_back(idx[j] - part.offsets[p]);
+    r.pos[p].push_back(static_cast<size_t>(j));
+  }
+  return r;
+}
+
+// gather the value rows for one part's routed positions
+static std::vector<float> gather_rows(const std::vector<size_t>& pos,
+                                      const float* vals, int64_t width) {
+  std::vector<float> pv(pos.size() * width);
+  for (size_t j = 0; j < pos.size(); ++j)
+    std::memcpy(pv.data() + j * width, vals + pos[j] * width,
+                width * sizeof(float));
+  return pv;
+}
+
+// copy into out+off clamped to the caller's buffer; a too-small caller
+// buffer must truncate, never wrap to a huge size_t
+static void copy_clamped(float* out, int64_t off, const float* src,
+                         size_t n, int64_t total) {
+  int64_t room = total - off;
+  if (room <= 0) return;
+  std::memcpy(out + off, src,
+              std::min<int64_t>(static_cast<int64_t>(n), room) *
+                  sizeof(float));
+}
+
+// run fn(p) for every part concurrently (fan-out latency stays flat as
+// the fleet grows); part 0 runs on the calling thread
+static void for_parts(int nparts, const std::function<void(int)>& fn) {
+  if (nparts <= 1) {
+    if (nparts == 1) fn(0);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(nparts - 1);
+  for (int p = 1; p < nparts; ++p) ts.emplace_back(fn, p);
+  fn(0);
+  for (auto& t : ts) t.join();
+}
+
 int InitTensor(int id, int ptype, int64_t len, int64_t width, int init_type,
                double init_a, double init_b, uint64_t seed, int otype,
                const float* lrs, int nlr) {
-  Writer w;
-  w.i32(ptype);
-  w.i64(len);
-  w.i64(width);
-  w.i32(init_type);
-  w.f64(init_a);
-  w.f64(init_b);
-  w.u64(seed);
-  w.i32(otype);
-  w.floats(lrs, static_cast<size_t>(nlr));
   auto& c = Client::Get();
-  return c.call(c.server_of(id), Op::kInitTensor, id, w, nullptr);
+  auto part = c.make_part(id, len, width);
+  c.register_part(id, part);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    w.i32(ptype);
+    w.i64(part.rows_of(p));   // each server owns only its row range
+    w.i64(width);
+    w.i32(init_type);
+    w.f64(init_a);
+    w.f64(init_b);
+    w.u64(seed + 0x9E3779B9u * static_cast<uint64_t>(p));  // decorrelate
+    w.i32(otype);
+    w.floats(lrs, static_cast<size_t>(nlr));
+    int rc = c.call(part.srv[p], Op::kInitTensor, id, w, nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
 }
 
 int Pull(int id, float* out, int64_t len) {
   auto& c = Client::Get();
-  std::vector<uint8_t> resp;
-  Writer w;
-  int rc = c.call(c.server_of(id), Op::kDensePull, id, w, &resp);
-  if (rc != 0) return rc;
-  hetups::Reader rd(resp.data(), resp.size());
-  size_t n;
-  const float* p = rd.floats(&n);
-  std::memcpy(out, p, std::min<size_t>(n, len) * sizeof(float));
+  auto part = c.part(id);
+  std::vector<int> rcs(part.nparts(), 0);
+  for_parts(part.nparts(), [&](int p) {
+    std::vector<uint8_t> resp;
+    Writer w;
+    rcs[p] = c.call(part.srv[p], Op::kDensePull, id, w, &resp);
+    if (rcs[p] != 0) return;
+    hetups::Reader rd(resp.data(), resp.size());
+    size_t n;
+    const float* src = rd.floats(&n);
+    copy_clamped(out, part.offsets[p] * part.width, src, n, len);
+  });
+  for (int rc : rcs)
+    if (rc != 0) return rc;
   return 0;
 }
 
 void Push(int id, const float* grad, int64_t len) {
   auto& c = Client::Get();
+  auto part = c.part(id);
   std::vector<float> g(grad, grad + len);
-  c.submit(id, [&c, id, g = std::move(g)] {
-    Writer w;
-    w.floats(g.data(), g.size());
-    c.call(c.server_of(id), Op::kDensePush, id, w, nullptr);
+  c.submit(id, [&c, id, part, g = std::move(g)] {
+    for (int p = 0; p < part.nparts(); ++p) {
+      int64_t off = part.offsets[p] * part.width;
+      int64_t n = part.split() ? part.rows_of(p) * part.width
+                               : static_cast<int64_t>(g.size());
+      Writer w;
+      w.floats(g.data() + off, static_cast<size_t>(n));
+      c.call(part.srv[p], Op::kDensePush, id, w, nullptr);
+    }
   });
 }
 
 void DDPushPull(int id, const float* grad, float* out, int64_t len) {
   auto& c = Client::Get();
+  auto part = c.part(id);
   std::vector<float> g(grad, grad + len);
-  c.submit(id, [&c, id, g = std::move(g), out, len] {
-    Writer w;
-    w.floats(g.data(), g.size());
-    std::vector<uint8_t> resp;
-    if (c.call(c.server_of(id), Op::kDDPushPull, id, w, &resp) == 0) {
-      hetups::Reader rd(resp.data(), resp.size());
-      size_t n;
-      const float* p = rd.floats(&n);
-      std::memcpy(out, p, std::min<size_t>(n, len) * sizeof(float));
+  c.submit(id, [&c, id, part, g = std::move(g), out, len] {
+    for (int p = 0; p < part.nparts(); ++p) {
+      int64_t off = part.offsets[p] * part.width;
+      int64_t n = part.split() ? part.rows_of(p) * part.width
+                               : static_cast<int64_t>(g.size());
+      Writer w;
+      w.floats(g.data() + off, static_cast<size_t>(n));
+      std::vector<uint8_t> resp;
+      if (c.call(part.srv[p], Op::kDDPushPull, id, w, &resp) == 0) {
+        hetups::Reader rd(resp.data(), resp.size());
+        size_t m;
+        const float* src = rd.floats(&m);
+        copy_clamped(out, off, src, m, len);
+      }
     }
   });
 }
@@ -329,48 +490,70 @@ void DDPushPull(int id, const float* grad, float* out, int64_t len) {
 void SparsePush(int id, const int64_t* idx, const float* vals, int64_t nidx,
                 int64_t width) {
   auto& c = Client::Get();
-  std::vector<int64_t> iv(idx, idx + nidx);
+  auto part = c.part(id);
+  auto route = route_sparse(part, idx, nidx);
   std::vector<float> vv(vals, vals + nidx * width);
-  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv)] {
-    Writer w;
-    w.longs(iv.data(), iv.size());
-    w.floats(vv.data(), vv.size());
-    c.call(c.server_of(id), Op::kSparsePush, id, w, nullptr);
+  c.submit(id, [&c, id, part, route = std::move(route),
+                vv = std::move(vv), width] {
+    for (int p = 0; p < part.nparts(); ++p) {
+      if (route.idx[p].empty()) continue;
+      auto pv = gather_rows(route.pos[p], vv.data(), width);
+      Writer w;
+      w.longs(route.idx[p].data(), route.idx[p].size());
+      w.floats(pv.data(), pv.size());
+      c.call(part.srv[p], Op::kSparsePush, id, w, nullptr);
+    }
   });
 }
 
 int SparsePull(int id, const int64_t* idx, float* out, int64_t nidx,
                int64_t width) {
   auto& c = Client::Get();
-  Writer w;
-  w.longs(idx, static_cast<size_t>(nidx));
-  std::vector<uint8_t> resp;
-  int rc = c.call(c.server_of(id), Op::kSparsePull, id, w, &resp);
-  if (rc != 0) return rc;
-  hetups::Reader rd(resp.data(), resp.size());
-  size_t n;
-  const float* p = rd.floats(&n);
-  std::memcpy(out, p,
-              std::min<size_t>(n, nidx * width) * sizeof(float));
+  auto part = c.part(id);
+  auto route = route_sparse(part, idx, nidx);
+  std::vector<int> rcs(part.nparts(), 0);
+  for_parts(part.nparts(), [&](int p) {
+    if (route.idx[p].empty()) return;
+    Writer w;
+    w.longs(route.idx[p].data(), route.idx[p].size());
+    std::vector<uint8_t> resp;
+    rcs[p] = c.call(part.srv[p], Op::kSparsePull, id, w, &resp);
+    if (rcs[p] != 0) return;
+    hetups::Reader rd(resp.data(), resp.size());
+    size_t n;
+    const float* rows = rd.floats(&n);
+    for (size_t j = 0; j < route.pos[p].size() && (j + 1) * width <= n;
+         ++j)
+      std::memcpy(out + route.pos[p][j] * width, rows + j * width,
+                  width * sizeof(float));
+  });
+  for (int rc : rcs)
+    if (rc != 0) return rc;
   return 0;
 }
 
 void SDPushPull(int id, const int64_t* idx, const float* vals, int64_t nidx,
                 float* out, int64_t out_len, int64_t width) {
   auto& c = Client::Get();
-  std::vector<int64_t> iv(idx, idx + nidx);
+  auto part = c.part(id);
+  auto route = route_sparse(part, idx, nidx);
   std::vector<float> vv(vals, vals + nidx * width);
-  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv), out,
-                out_len] {
-    Writer w;
-    w.longs(iv.data(), iv.size());
-    w.floats(vv.data(), vv.size());
-    std::vector<uint8_t> resp;
-    if (c.call(c.server_of(id), Op::kSDPushPull, id, w, &resp) == 0) {
-      hetups::Reader rd(resp.data(), resp.size());
-      size_t n;
-      const float* p = rd.floats(&n);
-      std::memcpy(out, p, std::min<size_t>(n, out_len) * sizeof(float));
+  c.submit(id, [&c, id, part, route = std::move(route), vv = std::move(vv),
+                out, out_len, width] {
+    // every part answers with its dense shard (even index-empty ones)
+    for (int p = 0; p < part.nparts(); ++p) {
+      auto pv = gather_rows(route.pos[p], vv.data(), width);
+      Writer w;
+      w.longs(route.idx[p].data(), route.idx[p].size());
+      w.floats(pv.data(), pv.size());
+      std::vector<uint8_t> resp;
+      if (c.call(part.srv[p], Op::kSDPushPull, id, w, &resp) == 0) {
+        hetups::Reader rd(resp.data(), resp.size());
+        size_t m;
+        const float* src = rd.floats(&m);
+        int64_t off = part.split() ? part.offsets[p] * part.width : 0;
+        copy_clamped(out, off, src, m, out_len);
+      }
     }
   });
 }
@@ -379,22 +562,30 @@ void SSPushPull(int id, const int64_t* in_idx, const float* vals,
                 int64_t nin, const int64_t* out_idx, int64_t nout,
                 float* out, int64_t width) {
   auto& c = Client::Get();
-  std::vector<int64_t> iv(in_idx, in_idx + nin);
+  auto part = c.part(id);
+  auto in_route = route_sparse(part, in_idx, nin);
+  auto out_route = route_sparse(part, out_idx, nout);
   std::vector<float> vv(vals, vals + nin * width);
-  std::vector<int64_t> ov(out_idx, out_idx + nout);
-  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv),
-                ov = std::move(ov), out, nout, width] {
-    Writer w;
-    w.longs(iv.data(), iv.size());
-    w.floats(vv.data(), vv.size());
-    w.longs(ov.data(), ov.size());
-    std::vector<uint8_t> resp;
-    if (c.call(c.server_of(id), Op::kSSPushPull, id, w, &resp) == 0) {
-      hetups::Reader rd(resp.data(), resp.size());
-      size_t n;
-      const float* p = rd.floats(&n);
-      std::memcpy(out, p,
-                  std::min<size_t>(n, nout * width) * sizeof(float));
+  c.submit(id, [&c, id, part, in_route = std::move(in_route),
+                out_route = std::move(out_route), vv = std::move(vv),
+                out, width] {
+    for (int p = 0; p < part.nparts(); ++p) {
+      if (in_route.idx[p].empty() && out_route.idx[p].empty()) continue;
+      auto pv = gather_rows(in_route.pos[p], vv.data(), width);
+      Writer w;
+      w.longs(in_route.idx[p].data(), in_route.idx[p].size());
+      w.floats(pv.data(), pv.size());
+      w.longs(out_route.idx[p].data(), out_route.idx[p].size());
+      std::vector<uint8_t> resp;
+      if (c.call(part.srv[p], Op::kSSPushPull, id, w, &resp) == 0) {
+        hetups::Reader rd(resp.data(), resp.size());
+        size_t n;
+        const float* rows = rd.floats(&n);
+        for (size_t j = 0;
+             j < out_route.pos[p].size() && (j + 1) * width <= n; ++j)
+          std::memcpy(out + out_route.pos[p][j] * width, rows + j * width,
+                      width * sizeof(float));
+      }
     }
   });
 }
@@ -405,40 +596,61 @@ void SSPushPull(int id, const int64_t* in_idx, const float* vals,
 int SyncEmbedding(int id, int64_t bound, const int64_t* idx, int64_t* ver,
                   int64_t nidx, float* out, int64_t width) {
   auto& c = Client::Get();
-  Writer w;
-  w.i64(bound);
-  w.longs(idx, static_cast<size_t>(nidx));
-  w.longs(ver, static_cast<size_t>(nidx));
-  std::vector<uint8_t> resp;
-  int rc = c.call(c.server_of(id), Op::kSyncEmbedding, id, w, &resp);
-  if (rc != 0) return rc < 0 ? rc : -rc;
-  hetups::Reader rd(resp.data(), resp.size());
-  size_t npos, nver, nrows;
-  const int64_t* pos = rd.longs(&npos);
-  const int64_t* sver = rd.longs(&nver);
-  const float* rows = rd.floats(&nrows);
-  for (size_t j = 0; j < npos; ++j) {
-    int64_t p = pos[j];
-    ver[p] = sver[j];
-    std::memcpy(out + p * width, rows + j * width,
-                width * sizeof(float));
-  }
-  return static_cast<int>(npos);
+  auto part = c.part(id);
+  auto route = route_sparse(part, idx, nidx);
+  std::vector<int> rcs(part.nparts(), 0);
+  std::atomic<int> refreshed{0};
+  for_parts(part.nparts(), [&](int p) {
+    if (route.idx[p].empty()) return;
+    std::vector<int64_t> pver(route.pos[p].size());
+    for (size_t j = 0; j < route.pos[p].size(); ++j)
+      pver[j] = ver[route.pos[p][j]];
+    Writer w;
+    w.i64(bound);
+    w.longs(route.idx[p].data(), route.idx[p].size());
+    w.longs(pver.data(), pver.size());
+    std::vector<uint8_t> resp;
+    rcs[p] = c.call(part.srv[p], Op::kSyncEmbedding, id, w, &resp);
+    if (rcs[p] != 0) return;
+    hetups::Reader rd(resp.data(), resp.size());
+    size_t npos, nver, nrows;
+    const int64_t* pos = rd.longs(&npos);   // positions in THIS sub-request
+    const int64_t* sver = rd.longs(&nver);
+    const float* rows = rd.floats(&nrows);
+    for (size_t j = 0; j < npos; ++j) {
+      size_t orig = route.pos[p][pos[j]];
+      ver[orig] = sver[j];
+      std::memcpy(out + orig * width, rows + j * width,
+                  width * sizeof(float));
+    }
+    refreshed += static_cast<int>(npos);
+  });
+  for (int rc : rcs)
+    if (rc != 0) return rc < 0 ? rc : -rc;
+  return refreshed.load();
 }
 
 void PushEmbedding(int id, const int64_t* idx, const float* vals,
                    const int64_t* updates, int64_t nidx, int64_t width) {
   auto& c = Client::Get();
-  std::vector<int64_t> iv(idx, idx + nidx);
+  auto part = c.part(id);
+  auto route = route_sparse(part, idx, nidx);
   std::vector<float> vv(vals, vals + nidx * width);
   std::vector<int64_t> uv(updates, updates + nidx);
-  c.submit(id, [&c, id, iv = std::move(iv), vv = std::move(vv),
-                uv = std::move(uv)] {
-    Writer w;
-    w.longs(iv.data(), iv.size());
-    w.floats(vv.data(), vv.size());
-    w.longs(uv.data(), uv.size());
-    c.call(c.server_of(id), Op::kPushEmbedding, id, w, nullptr);
+  c.submit(id, [&c, id, part, route = std::move(route), vv = std::move(vv),
+                uv = std::move(uv), width] {
+    for (int p = 0; p < part.nparts(); ++p) {
+      if (route.idx[p].empty()) continue;
+      auto pv = gather_rows(route.pos[p], vv.data(), width);
+      std::vector<int64_t> pu(route.pos[p].size());
+      for (size_t j = 0; j < route.pos[p].size(); ++j)
+        pu[j] = uv[route.pos[p][j]];
+      Writer w;
+      w.longs(route.idx[p].data(), route.idx[p].size());
+      w.floats(pv.data(), pv.size());
+      w.longs(pu.data(), pu.size());
+      c.call(part.srv[p], Op::kPushEmbedding, id, w, nullptr);
+    }
   });
 }
 
@@ -453,29 +665,61 @@ void BarrierWorker() {
 
 int SetParam(int id, const float* vals, int64_t len) {
   auto& c = Client::Get();
-  Writer w;
-  w.floats(vals, static_cast<size_t>(len));
-  return c.call(c.server_of(id), Op::kParamSet, id, w, nullptr);
+  auto part = c.part(id);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    int64_t off = part.offsets[p] * part.width;
+    int64_t n = part.split() ? part.rows_of(p) * part.width : len;
+    Writer w;
+    w.floats(vals + off, static_cast<size_t>(n));
+    int rc = c.call(part.srv[p], Op::kParamSet, id, w, nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
 }
 
 int Clear(int id) {
   auto& c = Client::Get();
-  Writer w;
-  return c.call(c.server_of(id), Op::kParamClear, id, w, nullptr);
+  auto part = c.part(id);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    int rc = c.call(part.srv[p], Op::kParamClear, id, w, nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
+}
+
+// split tensors save/load one file per range: <path>.part<p>
+static std::string part_path(const char* path, int p, bool split) {
+  if (!split) return path;
+  return std::string(path) + ".part" + std::to_string(p);
 }
 
 int SaveParam(int id, const char* path) {
   auto& c = Client::Get();
-  Writer w;
-  w.str(path);
-  return c.call(c.server_of(id), Op::kParamSave, id, w, nullptr);
+  auto part = c.part(id);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    w.str(part_path(path, p, part.split()).c_str());
+    int rc = c.call(part.srv[p], Op::kParamSave, id, w, nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
 }
 
 int LoadParam(int id, const char* path) {
   auto& c = Client::Get();
-  Writer w;
-  w.str(path);
-  return c.call(c.server_of(id), Op::kParamLoad, id, w, nullptr);
+  auto part = c.part(id);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    w.str(part_path(path, p, part.split()).c_str());
+    int rc = c.call(part.srv[p], Op::kParamLoad, id, w, nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
 }
 
 int PushData(int64_t key, const float* vals, int64_t n) {
@@ -502,17 +746,23 @@ int PullData(int64_t key, float* out, int64_t n) {
 
 uint64_t GetLoads() {
   auto& c = Client::Get();
-  Writer w;
-  std::vector<uint8_t> resp;
-  if (c.call(0, Op::kGetLoads, 0, w, &resp) != 0) return 0;
-  hetups::Reader rd(resp.data(), resp.size());
-  return rd.u64();
+  uint64_t total = 0;
+  for (int s = 0; s < std::max(1, c.nservers()); ++s) {
+    Writer w;
+    std::vector<uint8_t> resp;
+    if (c.call(s, Op::kGetLoads, 0, w, &resp) != 0) continue;
+    hetups::Reader rd(resp.data(), resp.size());
+    total += rd.u64();
+  }
+  return total;
 }
 
 void ShutdownServers() {
   auto& c = Client::Get();
-  Writer w;
-  c.call(0, Op::kShutdown, 0, w, nullptr);
+  for (int s = 0; s < std::max(1, c.nservers()); ++s) {
+    Writer w;
+    c.call(s, Op::kShutdown, 0, w, nullptr);
+  }
 }
 
 }  // extern "C"
