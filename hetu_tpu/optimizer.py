@@ -254,13 +254,24 @@ class OptimizerOp(Op):
     def compute(self, input_vals, ectx):
         opt = self.optimizer
         params = opt.params
+        # mixed precision: update the fp32 masters, upcasting the (bf16)
+        # gradients — ectx.params holds the compute-dtype copies
+        masters = getattr(ectx, "master_params", None) or ectx.params
         grad_vals = {}
         param_vals = {}
         for node, gval in zip(params, input_vals):
             if gval is None:
                 continue            # PS-managed parameter: updated server-side
+            pval = masters[node]
+            if hasattr(gval, "astype") and gval.dtype != pval.dtype:
+                gval = gval.astype(pval.dtype)
+            elif hasattr(gval, "values") and \
+                    gval.values.dtype != pval.dtype:
+                gval = type(gval)(indices=gval.indices,
+                                  values=gval.values.astype(pval.dtype),
+                                  dense_shape=gval.dense_shape)
             grad_vals[node] = gval
-            param_vals[node] = ectx.params[node]
+            param_vals[node] = pval
         lr = getattr(ectx, "lr", None)
         if lr is None:
             lr = opt.learning_rate

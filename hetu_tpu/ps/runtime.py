@@ -50,6 +50,15 @@ class PSRuntime:
         self.client = config.ps_comm
         self.registered = set()
         self.caches = {}        # param.id -> CacheSparseTable
+        # ASP pipelining (reference _compute_asp_prefetch): readback+push
+        # of sparse grads runs on this thread so the main loop can issue
+        # the next pull/step immediately; enabled by config.prefetch
+        # unless BSP (which must see every push before its barrier)
+        self._push_pool = None
+        self._pending_push = []
+        if config.prefetch and not config.bsp:
+            from concurrent.futures import ThreadPoolExecutor
+            self._push_pool = ThreadPoolExecutor(max_workers=1)
         # eager registration so save()/load() work before the first step
         self._register_all()
 
@@ -118,36 +127,46 @@ class PSRuntime:
         feed_dict = feed_dict or {}
 
         feed_map = {}
+        host_feeds = {}      # node -> host-side value (skip device_get)
         for node, value in feed_dict.items():
+            if isinstance(value, np.ndarray):
+                host_feeds[node] = value
             feed_map[node] = sub._ingest(value)
         for dl in sub.dataloader_ops:
-            feed_map[dl] = sub._ingest(dl.get_arr(sub.name))
+            value = dl.get_arr(sub.name)
+            if isinstance(value, np.ndarray):
+                host_feeds[dl] = value
+            feed_map[dl] = sub._ingest(value)
+
+        def host_ids(index_node, what):
+            if index_node in host_feeds:
+                return np.asarray(host_feeds[index_node])
+            if index_node in feed_map:
+                # device-resident ids: one readback round trip
+                return np.asarray(jax.device_get(feed_map[index_node]))
+            raise RuntimeError(
+                f"PS {what} requires its indices to be a feed or "
+                f"dataloader output")
 
         # 1. embedding rows for this batch (reference SparsePull /
-        # prefetch path, EmbeddingLookUp.py:27-40)
+        # prefetch path, EmbeddingLookUp.py:27-40). Duplicate ids in the
+        # batch are pulled once and scattered back on the host.
         for lk in sub.ps_lookups:
-            index_node = lk.inputs[1]
-            if index_node in feed_map:
-                idx = np.asarray(jax.device_get(feed_map[index_node]))
-            else:
-                raise RuntimeError(
-                    "PS embedding lookup requires its indices to be a "
-                    "feed or dataloader output")
+            idx = host_ids(lk.inputs[1], "embedding lookup")
             width = int(lk.inputs[0].shape[-1])
             cache = self.caches.get(lk.inputs[0].id)
             if cache is not None:
                 rows = cache.embedding_lookup(idx)
             else:
-                rows = client.sparse_pull(lk.inputs[0].id, idx, width)
+                uniq, inv = np.unique(idx.ravel(), return_inverse=True)
+                rows = client.sparse_pull(
+                    lk.inputs[0].id, uniq, width)[inv].reshape(
+                        idx.shape + (width,))
             feed_map[lk] = jax.device_put(rows)
         # explicit sparse-pull ops (inference path, reference
         # ParameterServerCommunicate.py:236-288) feed the same way
         for op in sub.ps_pull_ops:
-            index_node = op.inputs[0]
-            if index_node not in feed_map:
-                raise RuntimeError("PS sparse pull requires its indices "
-                                   "to be a feed or dataloader output")
-            idx = np.asarray(jax.device_get(feed_map[index_node]))
+            idx = host_ids(op.inputs[0], "sparse pull")
             width = int(op.parameter.shape[-1])
             rows = client.sparse_pull(op.parameter.id, idx, width)
             feed_map[op] = jax.device_put(rows)
@@ -173,18 +192,19 @@ class PSRuntime:
             param = op.parameter
             tid = param.id
             if isinstance(g, IndexedSlices):
-                width = int(param.shape[-1])
-                idx = np.asarray(jax.device_get(g.indices)).ravel()
-                vals = np.asarray(jax.device_get(g.values)).reshape(
-                    idx.size, width)
-                if nworkers > 1:
-                    vals = vals / nworkers
-                cache = self.caches.get(param.id)
-                if cache is not None:
-                    cache.embedding_update(idx, vals)
-                else:
-                    client.sparse_push(tid, idx, vals, width)
-                    client.wait(tid)
+                # cache updates are host-memory cheap and the cache object
+                # is driven from this thread — keep them inline
+                if self._push_pool is not None and \
+                        param.id not in self.caches:
+                    # ASP: readback + push off the critical path — the
+                    # next step's pull may see the table one push stale
+                    # (the reference's asynchronous PS training mode)
+                    self._drain_done()
+                    self._pending_push.append(self._push_pool.submit(
+                        self._push_sparse, param, g, nworkers))
+                    continue
+                self._push_sparse(param, g, nworkers)
+                client.wait(tid)
             else:
                 grad = np.asarray(jax.device_get(g)).ravel()
                 if nworkers > 1:
@@ -200,6 +220,9 @@ class PSRuntime:
         # (reference ParameterServerCommunicate.py:226-231)
         if self.config.bsp:
             client.barrier()
+        elif len(self._pending_push) > 4:
+            self._pending_push[0].result()   # bound the pipeline depth
+            self._drain_done()
 
         results = []
         from .. import ndarray as nd
@@ -213,8 +236,40 @@ class PSRuntime:
         return results
 
     # ------------------------------------------------------------------
+    def _push_sparse(self, param, g, nworkers):
+        """Readback one IndexedSlices grad and push it (runs on the push
+        thread under ASP, inline under BSP)."""
+        width = int(param.shape[-1])
+        idx = np.asarray(jax.device_get(g.indices)).ravel()
+        vals = np.asarray(jax.device_get(g.values)).reshape(
+            idx.size, width)
+        if nworkers > 1:
+            vals = vals / nworkers
+        cache = self.caches.get(param.id)
+        if cache is not None:
+            cache.embedding_update(idx, vals)
+        else:
+            self.client.sparse_push(param.id, idx, vals, width)
+
+    def _drain_done(self):
+        still = []
+        for f in self._pending_push:
+            if f.done():
+                f.result()          # surface push-thread exceptions
+            else:
+                still.append(f)
+        self._pending_push = still
+
+    def drain(self):
+        """Block until every in-flight ASP push has reached the server."""
+        for f in self._pending_push:
+            f.result()
+        self._pending_push.clear()
+        self.client.wait_all()
+
     def save(self, path):
         import os
+        self.drain()
         for cache in self.caches.values():
             cache.flush()       # pending grads reach the server first
         for op_param_id in sorted(self.registered):

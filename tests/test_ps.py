@@ -158,9 +158,12 @@ def test_ps_training_matches_local(ps):
         base.append(exe.run(feed_dict={dense: d, sparse: s, y_: y}
                             )[0].asnumpy().item())
 
-    # PS mode: every trainable routes through the server
+    # PS mode: every trainable routes through the server. prefetch=False
+    # forces synchronous pushes (the default is the reference's ASP
+    # pipeline, which is one push stale and wouldn't match loss-for-loss)
     dense, sparse, y_, loss, train_op = _ctr_graph(0)
-    exe_ps = Executor([loss, train_op], ctx=ht.tpu(0), comm_mode="PS")
+    exe_ps = Executor([loss, train_op], ctx=ht.tpu(0), comm_mode="PS",
+                      prefetch=False)
     sub = exe_ps.subexecutors["default"]
     assert len(sub.ps_ops) == 2 and len(sub.ps_lookups) == 1
     # embedding table must NOT be materialized on the worker
