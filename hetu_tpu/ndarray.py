@@ -302,30 +302,60 @@ class IndexedSlices:
 class CSRValue:
     """Traced CSR triple with static shape — the in-graph value form of
     ND_Sparse_Array (nrow/ncol stay static so segment_sum sizes are
-    compile-time constants)."""
+    compile-time constants).
 
-    __slots__ = ("data", "indptr", "indices", "nrow", "ncol")
+    ``row_ids`` (the per-nnz row index, i.e. the COO row array) is a pure
+    function of ``indptr``; it is precomputed once at ingest so csrmm /
+    csrmv never re-derive it with a searchsorted over nnz inside every
+    forward and backward call (the reference's cuSPARSE kernels get it for
+    free from the CSR walk, src/ops/CuSparseCsrmm.cu).
 
-    def __init__(self, data, indptr, indices, nrow, ncol):
+    ``t_data/t_indices/t_row_ids`` hold A^T in the same COO-sorted form
+    (entries sorted by column). The transposed product in every csrmm
+    backward then lowers to a gather + *sorted* segment-sum instead of a
+    general scatter — the TPU analogue of cuSPARSE keeping a CSC copy for
+    the transposed kernels."""
+
+    __slots__ = ("data", "indptr", "indices", "nrow", "ncol", "row_ids",
+                 "t_data", "t_indices", "t_row_ids")
+
+    def __init__(self, data, indptr, indices, nrow, ncol, row_ids=None,
+                 t_data=None, t_indices=None, t_row_ids=None):
         self.data = data
         self.indptr = indptr
         self.indices = indices
         self.nrow = nrow
         self.ncol = ncol
+        self.row_ids = row_ids
+        self.t_data = t_data          # data sorted by column
+        self.t_indices = t_indices    # original row per entry (A^T's cols)
+        self.t_row_ids = t_row_ids    # sorted columns (A^T's rows)
 
     @classmethod
     def from_sparse_array(cls, sp: "ND_Sparse_Array"):
         def as_jax(v):
             return v.jax_array if isinstance(v, NDArray) else jnp.asarray(v)
+        def host(v):
+            return np.asarray(v.asnumpy() if isinstance(v, NDArray) else v)
+        indptr_host = host(sp.row)
+        indices_host = host(sp.indices if hasattr(sp, "indices") else sp.col)
+        data_host = host(sp.data)
+        row_ids = np.repeat(
+            np.arange(sp.nrow, dtype=np.int32), np.diff(indptr_host))
+        perm = np.argsort(indices_host, kind="stable")
         return cls(as_jax(sp.data), as_jax(sp.row), as_jax(sp.col),
-                   sp.nrow, sp.ncol)
+                   sp.nrow, sp.ncol, jnp.asarray(row_ids),
+                   jnp.asarray(data_host[perm]),
+                   jnp.asarray(row_ids[perm]),
+                   jnp.asarray(indices_host[perm].astype(np.int32)))
 
 
 jax.tree_util.register_pytree_node(
     CSRValue,
-    lambda s: ((s.data, s.indptr, s.indices), (s.nrow, s.ncol)),
+    lambda s: ((s.data, s.indptr, s.indices, s.row_ids,
+                s.t_data, s.t_indices, s.t_row_ids), (s.nrow, s.ncol)),
     lambda aux, leaves: CSRValue(leaves[0], leaves[1], leaves[2],
-                                 aux[0], aux[1]),
+                                 aux[0], aux[1], *leaves[3:]),
 )
 
 

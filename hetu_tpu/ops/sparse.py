@@ -15,13 +15,23 @@ from ..graph.node import Op
 __all__ = ["csrmv_op", "csrmm_op"]
 
 
-def _csr_matmul(data, indptr, indices, dense, nrow):
-    """y[i] = sum_j A[i,j] * dense[j, :] for CSR A."""
-    nnz = data.shape[0]
-    # row id per nnz element from indptr (searchsorted is O(nnz log nrow))
-    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+def _row_ids(sp):
+    """Per-nnz row index: precomputed at ingest (CSRValue.row_ids) for the
+    hot path; searchsorted fallback for hand-built CSR pytrees."""
+    if getattr(sp, "row_ids", None) is not None:
+        return sp.row_ids
+    nnz = sp.data.shape[0]
+    return jnp.searchsorted(sp.indptr, jnp.arange(nnz), side="right") - 1
+
+
+def _csr_matmul(data, row_ids, indices, dense, nrow):
+    """y[i] = sum_j A[i,j] * dense[j, :] for CSR A (COO row array form).
+    row_ids comes from a CSR walk, so it is non-decreasing —
+    indices_are_sorted lets XLA lower the scatter-add without the
+    general-case sort/unique machinery."""
     gathered = dense[indices] * data[:, None]
-    return jax.ops.segment_sum(gathered, row_ids, num_segments=nrow)
+    return jax.ops.segment_sum(gathered, row_ids, num_segments=nrow,
+                               indices_are_sorted=True)
 
 
 class CsrmmOp(Op):
@@ -35,18 +45,20 @@ class CsrmmOp(Op):
 
     def compute(self, input_vals, ectx):
         sp, dense = input_vals
-        data, indptr, indices, nrow, ncol = (
-            sp.data, sp.indptr, sp.indices, sp.nrow, sp.ncol)
+        data, indices, nrow, ncol = sp.data, sp.indices, sp.nrow, sp.ncol
         if self.trans_B:
             dense = dense.T
         if self.trans_A:
-            # A^T @ B = scatter rows of B by column index
-            contrib = dense[jnp.searchsorted(
-                indptr, jnp.arange(data.shape[0]), side="right") - 1]
-            out = jax.ops.segment_sum(contrib * data[:, None],
-                                      indices, num_segments=ncol)
-            return out
-        return _csr_matmul(data, indptr, indices, dense, nrow)
+            if getattr(sp, "t_data", None) is not None:
+                # ingest precomputed A^T in COO-sorted form: sorted
+                # scatter, same lowering as the forward
+                return _csr_matmul(sp.t_data, sp.t_row_ids, sp.t_indices,
+                                   dense, ncol)
+            # fallback: general scatter by column index
+            contrib = dense[_row_ids(sp)]
+            return jax.ops.segment_sum(contrib * data[:, None],
+                                       indices, num_segments=ncol)
+        return _csr_matmul(data, _row_ids(sp), indices, dense, nrow)
 
     def gradient(self, output_grad):
         # grad wrt dense operand: A^T @ dy (transposed again if the forward
@@ -74,16 +86,18 @@ class CsrmvOp(Op):
 
     def compute(self, input_vals, ectx):
         sp, vec = input_vals
-        data, indptr, indices, nrow, ncol = (
-            sp.data, sp.indptr, sp.indices, sp.nrow, sp.ncol)
-        nnz = data.shape[0]
-        row_ids = jnp.searchsorted(indptr, jnp.arange(nnz),
-                                   side="right") - 1
+        data, indices, nrow, ncol = sp.data, sp.indices, sp.nrow, sp.ncol
+        row_ids = _row_ids(sp)
         if self.trans:
+            if getattr(sp, "t_data", None) is not None:
+                return jax.ops.segment_sum(
+                    vec[sp.t_indices] * sp.t_data, sp.t_row_ids,
+                    num_segments=ncol, indices_are_sorted=True)
             return jax.ops.segment_sum(vec[row_ids] * data, indices,
                                        num_segments=ncol)
         return jax.ops.segment_sum(vec[indices] * data, row_ids,
-                                   num_segments=nrow)
+                                   num_segments=nrow,
+                                   indices_are_sorted=True)
 
     def gradient(self, output_grad):
         grad_b = csrmv_op(self.inputs[0], output_grad,
