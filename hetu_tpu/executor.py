@@ -88,9 +88,9 @@ class HetuConfig:
                  val_name="default", ctx=None, seed=0, comm_mode=None,
                  use_sparse_pull=True, cstable_policy=None, bsp=False,
                  prefetch=True, enable_lazy=False, cache_bound=100,
-                 log_path=None, gpipe=False, pipedream=False,
-                 dynamic_memory=False, mesh=None, dtype=None,
-                 num_microbatches=None):
+                 cache_capacity=None, log_path=None, gpipe=False,
+                 pipedream=False, dynamic_memory=False, mesh=None,
+                 dtype=None, num_microbatches=None):
         maybe_init_distributed()
         self.eval_node_list = eval_node_list
         self.train_name = train_name
@@ -103,6 +103,7 @@ class HetuConfig:
         self.prefetch = prefetch
         self.enable_lazy = enable_lazy
         self.cache_bound = cache_bound
+        self.cache_capacity = cache_capacity
         self.log_path = log_path
         self.use_gpipe = gpipe
         self.use_pipedream = pipedream
@@ -130,6 +131,18 @@ class HetuConfig:
         self.spmd_axis = None         # set inside shard_map tracing only
         self.node_status = {}         # TP planner output
 
+        # -- device-resident embedding cache (HET path) ------------------
+        # cstable_policy="Device" rewrites PS-managed embedding lookups to
+        # gather from an HBM cache parameter; the PS runtime keeps the
+        # cache coherent with the server under a staleness bound (see
+        # ps/device_cache.py). The reference's host-memory cache policies
+        # (LRU/LFU/LFUOpt) stay on the host path in ps/runtime.py.
+        self.device_cache_tables = []
+        if self.cstable_policy == "Device" and \
+                self.comm_mode in ("PS", "Hybrid"):
+            self._rewrite_device_cache(eval_node_list)
+            self.cstable_policy = None  # host cache path stays off
+
         # -- device mesh -----------------------------------------------
         self.mesh = mesh
         if self.mesh is None and self.comm_mode in ("AllReduce", "Hybrid"):
@@ -152,6 +165,84 @@ class HetuConfig:
             self.ps_comm = get_default_client()
 
         self.placeholder_to_arr_map = {}
+
+    def _rewrite_device_cache(self, eval_node_list):
+        """Rewrite PS-embedding lookups onto device-cache parameters.
+
+        For each PS-managed embedding table T consumed by
+        ``EmbeddingLookUp(T, ids)``:
+
+          * a cache parameter ``[capacity+1, width]`` (last row = scratch
+            slot for padded scatters) replaces T in the graph and in the
+            optimizer's parameter list — the worker optimizer applies the
+            local sparse update in-graph (HET local update),
+          * a slots placeholder replaces ``ids`` in the lookup and its
+            gradient, fed per step by the PS runtime's id->slot map,
+          * T itself only lives on the PS server; the runtime registers
+            it and drains accumulated gradients to it.
+        """
+        from .initializers import ZerosInit
+        from .ops.embedding import EmbeddingLookUp, EmbeddingLookUpGradient
+
+        topo = find_topo_sort(eval_node_list)
+        lookups_by_table = {}
+        for n in topo:
+            if not isinstance(n, EmbeddingLookUp):
+                continue
+            tbl = n.inputs[0]
+            if not (isinstance(tbl, PlaceholderOp) and tbl.trainable):
+                continue
+            strategy = self.node_strategy.get(tbl) or self.comm_mode
+            if strategy not in ("PS", "Hybrid"):
+                continue
+            lookups_by_table.setdefault(tbl, []).append(n)
+        if not lookups_by_table:
+            return
+        grads = [n for n in topo if isinstance(n, EmbeddingLookUpGradient)]
+        optimizer_ops = [n for n in topo if isinstance(n, OptimizerOp)]
+
+        for tbl, lookups in lookups_by_table.items():
+            rows, width = int(tbl.shape[0]), int(np.prod(tbl.shape[1:]))
+            capacity = min(rows, int(self.cache_capacity or (1 << 20)))
+            cache = PlaceholderOp(
+                f"{tbl.name}__dcache",
+                initializer=ZerosInit((capacity + 1, width)),
+                trainable=True)
+            cache.is_embed = True
+            cache.device_cached = True
+            cache.cache_table = tbl
+            cache.stateful = True
+            cache.state_shapes = \
+                lambda shapes, c=capacity + 1, w=width: {"acc": (c, w)}
+            slots_by_ids = {}
+            slots_of_lookup = {}
+            for lk in lookups:
+                ids = lk.inputs[1]
+                if ids not in slots_by_ids:
+                    s = PlaceholderOp(
+                        f"{tbl.name}__slots{len(slots_by_ids)}",
+                        trainable=False, dtype=np.int32)
+                    slots_by_ids[ids] = s
+                slots_of_lookup[lk] = slots_by_ids[ids]
+            for g in grads:
+                if g.forward_node in slots_of_lookup:
+                    g.inputs = [g.inputs[0], slots_of_lookup[g.forward_node]]
+                    g.embed_shape = (capacity + 1, width)
+            for lk in lookups:
+                lk.inputs = [cache, slots_of_lookup[lk]]
+            table_opt = None
+            for opt_op in optimizer_ops:
+                params = opt_op.optimizer.params
+                for i, p in enumerate(params):
+                    if p is tbl:
+                        params[i] = cache
+                        table_opt = opt_op.optimizer
+            self.device_cache_tables.append({
+                "table": tbl, "cache": cache,
+                "slots_by_ids": dict(slots_by_ids),
+                "capacity": capacity, "width": width, "rows": rows,
+                "optimizer": table_opt,
+            })
 
     def _build_dp_mesh(self):
         from jax.sharding import Mesh
@@ -226,6 +317,11 @@ class SubExecutor:
         self.ps_lookups = [n for n in self.topo_order
                            if isinstance(n, EmbeddingLookUp)
                            and n.inputs[0] in ps_params]
+        # device-cached lookups: slots fed by the PS runtime's id->slot map
+        self.cached_lookups = [n for n in self.topo_order
+                               if isinstance(n, EmbeddingLookUp)
+                               and getattr(n.inputs[0], "device_cached",
+                                           False)]
         # PS-managed embedding tables never materialize on the worker;
         # their lookups are fed from SparsePull (reference prefetch
         # ps_map, executor.py:1634-1636)
@@ -356,7 +452,10 @@ class SubExecutor:
         return step_fn
 
     def _compile_step(self):
-        donate = (0, 2) if self.training else ()
+        # donate params, op state and optimizer slots: the update is
+        # in-place in HBM (state matters for the device-cache acc, which
+        # is table-sized)
+        donate = (0, 1, 2) if self.training else ()
         return jax.jit(self._build_step(), donate_argnums=donate)
 
     def trace_args(self, executor, feed_map):
@@ -379,7 +478,8 @@ class SubExecutor:
 
     # ------------------------------------------------------------------
     def run(self, executor, feed_dict=None, convert_to_numpy_ret_vals=False):
-        needs_ps = self.ps_ops or self.ps_lookups or self.ps_pull_ops
+        needs_ps = (self.ps_ops or self.ps_lookups or self.ps_pull_ops
+                    or self.cached_lookups)
         assert not needs_ps or executor.ps_runtime is not None, \
             "PS-mode graph requires the parameter-server runtime"
         if needs_ps:
@@ -469,6 +569,15 @@ class Executor:
             if isinstance(node, PlaceholderOp) and (
                     node.tensor_value is not None
                     or node.initializer is not None):
+                if getattr(node, "device_cached", False):
+                    # cache rows fill from the PS server on miss; create
+                    # the zeros buffer on device — a 512MB h2d of zeros
+                    # over a remote tunnel would dominate startup
+                    arr = jnp.zeros(node.shape, jnp.float32)
+                    self.params[str(node.id)] = arr
+                    self._param_nodes[str(node.id)] = node
+                    config.placeholder_to_arr_map[node] = arr
+                    continue
                 value = node.initial_value(seed=config.seed)
                 spec = config.spec_for(node)
                 if spec is not None and config.mesh is not None:
@@ -580,6 +689,11 @@ class Executor:
         if self.config.ps_comm is not None:
             return self.config.ps_comm.get_loads()
         return {}
+
+    def close(self):
+        """Flush in-flight PS work (ASP pushes, device-cache drains)."""
+        if self.ps_runtime is not None:
+            self.ps_runtime.close()
 
     def __del__(self):
         pass

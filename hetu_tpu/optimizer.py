@@ -272,6 +272,15 @@ class OptimizerOp(Op):
                                   dense_shape=gval.dense_shape)
             grad_vals[node] = gval
             param_vals[node] = pval
+            if getattr(node, "device_cached", False) and \
+                    isinstance(gval, IndexedSlices):
+                # HET push accumulator: raw grads scatter-add into HBM
+                # state; the PS runtime drains it to the server every
+                # push_bound steps (ps/runtime.py _drain_device_table)
+                acc = ectx.state[node]["acc"]
+                ectx.new_state[node] = {"acc": acc.at[
+                    gval.get_flat_indices()].add(
+                        gval.get_dense_rows().astype(acc.dtype))}
         lr = getattr(ectx, "lr", None)
         if lr is None:
             lr = opt.learning_rate
@@ -297,7 +306,13 @@ class OptimizerOp(Op):
         new_inputs = []
         for grad, param in zip(self.inputs, self.optimizer.params):
             strategy = config.node_strategy.get(param) or config.comm_mode
-            if strategy == "PS" or (strategy == "Hybrid" and param.is_embed):
+            if getattr(param, "device_cached", False):
+                # HET device-cache path: the worker optimizer applies the
+                # local sparse update in-graph; accumulated grads drain to
+                # the server from the PS runtime, not via a comm op
+                comm = grad
+            elif strategy == "PS" or (strategy == "Hybrid"
+                                      and param.is_embed):
                 comm = parameterServerCommunicate_op(
                     grad, param, self.optimizer, ctx=grad.raw_ctx)
                 config.ps_nodes.append(comm)

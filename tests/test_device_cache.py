@@ -1,0 +1,208 @@
+"""Device-resident embedding cache (HET path, ps/device_cache.py).
+
+The cache keeps embedding rows in HBM as a jit-threaded parameter with
+local worker updates and drains accumulated gradients to the PS server
+under a staleness bound. With one worker and SGD this is *exactly*
+local training (reference HET invariant), which these tests exploit.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+from hetu_tpu.ps import client as ps_client
+from hetu_tpu.ps import server as ps_server
+
+
+@pytest.fixture()
+def ps_env():
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    yield client
+    client.shutdown_servers()
+    ps_client.close_default_client()
+    ps_server.shutdown_server()
+
+
+def _embed_model(table_value, lr=0.1):
+    """Sparse-only model: loss = mean((sum_slot emb - y)^2)."""
+    ids = ht.Variable("dc_ids", trainable=False)
+    y_ = ht.Variable("dc_y", trainable=False)
+    table = ht.Variable("dc_table", value=table_value)
+    rows = ht.embedding_lookup_op(table, ids)            # [B, S, D]
+    pred = ht.reduce_sum_op(rows, [1])                   # [B, D]
+    diff = pred + (-1) * y_
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(diff * diff, [1]), [0])
+    opt = ht.optim.SGDOptimizer(lr)
+    return ids, y_, loss, opt.minimize(loss)
+
+
+def _run_steps(exe, ids_node, y_node, batches, convert=True):
+    losses = []
+    for ids, y in batches:
+        out = exe.run(feed_dict={ids_node: ids, y_node: y},
+                      convert_to_numpy_ret_vals=True)
+        losses.append(float(out[0]))
+    return losses
+
+
+def _make_batches(rng, steps, rows, batch=8, nslot=3, width=4):
+    return [(rng.randint(0, rows, (batch, nslot)),
+             rng.randn(batch, width).astype(np.float32))
+            for _ in range(steps)]
+
+
+def test_device_cache_matches_local(ps_env):
+    rng = np.random.RandomState(0)
+    table = rng.randn(50, 4).astype(np.float32)
+    batches = _make_batches(rng, steps=12, rows=50)
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=4)
+    got = _run_steps(exe, ids, y_, batches)
+    exe.close()
+
+    ids2, y2, loss2, train2 = _embed_model(table)
+    ref_exe = Executor([loss2, train2], comm_mode=None)
+    want = _run_steps(ref_exe, ids2, y2, batches)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_device_cache_eviction_matches_local(ps_env):
+    """Capacity far below the id range forces evict/refault cycles; the
+    server round-trip must reproduce the evicted rows exactly."""
+    rng = np.random.RandomState(1)
+    table = rng.randn(64, 4).astype(np.float32)
+    batches = _make_batches(rng, steps=20, rows=64)
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=3, cache_capacity=32)
+    got = _run_steps(exe, ids, y_, batches)
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    assert rt.evicts > 0, "test must actually exercise eviction"
+    exe.close()
+
+    ids2, y2, loss2, train2 = _embed_model(table)
+    ref_exe = Executor([loss2, train2], comm_mode=None)
+    want = _run_steps(ref_exe, ids2, y2, batches)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_drain_syncs_server_to_cache(ps_env):
+    """After drain(), server rows == device cache rows (SGD commutes:
+    local update and server apply see the same gradient sums)."""
+    rng = np.random.RandomState(2)
+    table = rng.randn(30, 4).astype(np.float32)
+    batches = _make_batches(rng, steps=7, rows=30)
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=100)   # no drain during the run
+    _run_steps(exe, ids, y_, batches)
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    assert rt.dirty.any(), "updates should be pending before drain"
+    exe.ps_runtime.drain()
+    assert not rt.dirty.any()
+
+    cache = np.asarray(exe.params[rt.cache_sid])
+    touched = np.nonzero(rt.id_of >= 0)[0]
+    server_rows = ps_env.sparse_pull(rt.tid, rt.id_of[touched], rt.width)
+    np.testing.assert_allclose(server_rows, cache[touched], rtol=1e-5)
+    exe.close()
+
+
+def test_device_cache_bsp_full_model_matches_local(ps_env):
+    """BSP + device cache: dense params round-trip synchronously through
+    the server SGD, sparse drains every step — exact local equivalence
+    for a model with both dense and embedding parameters."""
+    rng = np.random.RandomState(3)
+    table = rng.randn(40, 4).astype(np.float32)
+    w_val = rng.randn(4, 2).astype(np.float32)
+
+    def build():
+        ids = ht.Variable("m_ids", trainable=False)
+        y_ = ht.Variable("m_y", trainable=False)
+        tbl = ht.Variable("m_table", value=table)
+        w = ht.Variable("m_w", value=w_val)
+        rows = ht.embedding_lookup_op(tbl, ids)
+        pred = ht.matmul_op(ht.reduce_sum_op(rows, [1]), w)
+        diff = pred + (-1) * y_
+        loss = ht.reduce_mean_op(ht.reduce_sum_op(diff * diff, [1]), [0])
+        train = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        return ids, y_, loss, train
+
+    batches = [(rng.randint(0, 40, (8, 3)),
+                rng.randn(8, 2).astype(np.float32)) for _ in range(8)]
+
+    ids, y_, loss, train = build()
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   bsp=True)
+    got = _run_steps(exe, ids, y_, batches)
+    exe.close()
+
+    ids2, y2, loss2, train2 = build()
+    ref_exe = Executor([loss2, train2], comm_mode=None)
+    want = _run_steps(ref_exe, ids2, y2, batches)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_async_dense_pipeline_trains(ps_env):
+    """ASP dense pipeline (accumulate + background DDPushPull): not
+    step-equivalent to local SGD, but must converge on a linear-regression
+    toy and leave finite parameters."""
+    rng = np.random.RandomState(4)
+    table = rng.randn(40, 4).astype(np.float32)
+
+    ids = ht.Variable("a_ids", trainable=False)
+    y_ = ht.Variable("a_y", trainable=False)
+    tbl = ht.Variable("a_table", value=table)
+    w = ht.Variable("a_w", value=rng.randn(4, 2).astype(np.float32) * 0.1)
+    rows = ht.embedding_lookup_op(tbl, ids)
+    pred = ht.matmul_op(ht.reduce_sum_op(rows, [1]), w)
+    diff = pred + (-1) * y_
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(diff * diff, [1]), [0])
+    train = ht.optim.SGDOptimizer(0.02).minimize(loss)
+
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=4)
+    fixed_ids = rng.randint(0, 40, (8, 3))
+    fixed_y = rng.randn(8, 2).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        out = exe.run(feed_dict={ids: fixed_ids, y_: fixed_y},
+                      convert_to_numpy_ret_vals=True)
+        losses.append(float(out[0]))
+    exe.ps_runtime.drain()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    assert np.isfinite(np.asarray(exe.params[str(w.id)])).all()
+    exe.close()
+
+
+def test_device_cache_save_load(ps_env, tmp_path):
+    rng = np.random.RandomState(5)
+    table = rng.randn(30, 4).astype(np.float32)
+    batches = _make_batches(rng, steps=5, rows=30)
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device")
+    _run_steps(exe, ids, y_, batches)
+    exe.save(str(tmp_path))
+    before = {int(i): ps_env.sparse_pull(
+        next(iter(exe.ps_runtime.device_tables)), np.array([i]), 4).copy()
+        for i in range(30)}
+    # poke the server, then load back
+    tid = next(iter(exe.ps_runtime.device_tables))
+    ps_env.set_param(tid, np.zeros((30, 4), np.float32))
+    exe.load(str(tmp_path))
+    after = ps_env.sparse_pull(tid, np.arange(30), 4)
+    want = np.concatenate([before[i] for i in range(30)], axis=0)
+    np.testing.assert_allclose(after, want, rtol=1e-6)
+    exe.close()
