@@ -138,6 +138,8 @@ class HetuConfig:
         # ps/device_cache.py). The reference's host-memory cache policies
         # (LRU/LFU/LFUOpt) stay on the host path in ps/runtime.py.
         self.device_cache_tables = []
+        self.ps_dense_cached = []     # [(param, optimizer)] — see
+        # optimizer.backward_hook's unified dense HET treatment
         if self.cstable_policy == "Device" and \
                 self.comm_mode in ("PS", "Hybrid"):
             self._rewrite_device_cache(eval_node_list)
@@ -458,6 +460,130 @@ class SubExecutor:
         donate = (0, 1, 2) if self.training else ()
         return jax.jit(self._build_step(), donate_argnums=donate)
 
+    def _build_block(self, nsteps):
+        """``nsteps`` training steps as ONE compiled program: a lax.scan
+        over stacked feeds. Per-invocation dispatch/transfer overhead —
+        which dominates on a high-latency host link — amortizes by
+        1/nsteps; the math is bit-identical to ``nsteps`` separate calls
+        (params/state/opt thread through the scan carry exactly as they
+        thread through the host loop)."""
+        step_fn = self._build_step()
+        out_is_none = [n in set(self.optimizer_ops)
+                       for n in self.eval_node_list]
+
+        def block_fn(params, state, opt_state, feeds_stacked, lrs, step0,
+                     rng):
+            def body(carry, xs):
+                params, state, opt = carry
+                step_idx, lr = xs[0], xs[1]
+                feeds = list(xs[2:])
+                outputs, p, s, o, _ = step_fn(params, state, opt, feeds,
+                                              lr, step_idx, rng)
+                outs = [v for v, none in zip(outputs, out_is_none)
+                        if not none]
+                return (p, s, o), outs
+            steps = step0 + jnp.arange(nsteps, dtype=jnp.int32)
+            carry, outs = jax.lax.scan(
+                body, (params, state, opt_state),
+                tuple([steps, lrs] + list(feeds_stacked)))
+            return outs, carry[0], carry[1], carry[2]
+
+        donate = (0, 1, 2) if self.training else ()
+        return jax.jit(block_fn, donate_argnums=donate)
+
+    def run_block(self, executor, feed_dicts,
+                  convert_to_numpy_ret_vals=False):
+        """Run ``len(feed_dicts)`` steps in one dispatch (host-feed path;
+        the PS runtime has its own block path). Returns per-step results:
+        a list of output lists."""
+        assert not (self.ps_ops or self.ps_lookups or self.ps_pull_ops), \
+            "PS graphs run blocks through the PS runtime"
+        nsteps = len(feed_dicts)
+        feed_map = {}      # node -> stacked device value
+        first_map = {}     # node -> step-0 value (shape inference)
+        for node in (feed_dicts[0] or {}):
+            feed_map[node], first_map[node] = self._stack_feed(
+                [fd[node] for fd in feed_dicts])
+        for dl in self.dataloader_ops:
+            stacked = np.stack([np.asarray(dl.get_arr(self.name))
+                                for _ in range(nsteps)])
+            feed_map[dl] = self._ingest_stacked(stacked)
+            first_map[dl] = stacked[0]
+        return self._dispatch_block(executor, feed_map, first_map, nsteps,
+                                    convert_to_numpy_ret_vals)
+
+    def _dispatch_block(self, executor, feed_map, first_map, nsteps,
+                        convert):
+        """Compile-or-reuse the nsteps scan block and dispatch it (shared
+        by the host-feed path above and the PS runtime's block path)."""
+        key = ("block", nsteps) + self._shape_key(first_map)
+        if key not in self.compiled:
+            self._infer_shapes(first_map)
+            self._ensure_state(executor)
+            self.compiled[key] = self._build_block(nsteps)
+        fn = self.compiled[key]
+        feeds = [feed_map[n] for n in self._feed_order()]
+        # per-step learning rates: the scheduler advances exactly as it
+        # would across nsteps sequential run() calls
+        lrs = np.zeros(nsteps, np.float32)
+        for opt in self.optimizer_ops:
+            sched = opt.optimizer.lr_sched
+            for k in range(nsteps):
+                lrs[k] = np.float32(sched.get())
+                if self.training:
+                    sched.step()
+        outs, new_params, new_state, new_opt = fn(
+            executor.params, executor.state, executor.opt_state, feeds,
+            lrs, np.int32(self.step_count), executor.base_rng)
+        if self.training:
+            executor.params = new_params
+            executor.state = new_state
+            executor.opt_state = new_opt
+        self.step_count += nsteps
+        return self._split_block_outputs(outs, nsteps, convert)
+
+    def _split_block_outputs(self, outs, nsteps, convert):
+        out_is_none = [n in set(self.optimizer_ops)
+                       for n in self.eval_node_list]
+        results = []
+        for k in range(nsteps):
+            row, it = [], iter(outs)
+            for none in out_is_none:
+                if none:
+                    row.append(None)
+                else:
+                    v = next(it)[k]
+                    row.append(np.asarray(v) if convert
+                               else ndarray.NDArray(v, None))
+            results.append(row)
+        return results
+
+    def _stack_feed(self, values):
+        """Per-step feed values -> one stacked [nsteps, ...] device value.
+        The same host array fed for every step tiles on device instead of
+        transferring nsteps copies (broadcast is free in HBM; transfers
+        are the scarce resource on a remote host link)."""
+        first = values[0]
+        if all(v is first for v in values):
+            arr = self._ingest(first)
+            tiled = jnp.broadcast_to(arr[None],
+                                     (len(values),) + tuple(arr.shape))
+            return tiled, np.asarray(first)
+        stacked = np.stack([np.asarray(v) for v in values])
+        return self._ingest_stacked(stacked), stacked[0]
+
+    def _ingest_stacked(self, arr):
+        """Stacked [nsteps, ...] host feed -> device; batch-dim sharding
+        applies to dim 1 (dim 0 is the scan axis)."""
+        sharding = self.config.data_sharding(arr.ndim)
+        if sharding is not None and arr.ndim >= 2 and \
+                arr.shape[1] % self.config.nrank == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            spec = P(*((None, "dp") + (None,) * (arr.ndim - 2)))
+            return jax.device_put(
+                arr, NamedSharding(self.config.mesh, spec))
+        return jax.device_put(arr)
+
     def trace_args(self, executor, feed_map):
         """The argument tuple ``step_fn`` expects for this feed map —
         used by compile-check harnesses (__graft_entry__) and run()."""
@@ -569,7 +695,7 @@ class Executor:
             if isinstance(node, PlaceholderOp) and (
                     node.tensor_value is not None
                     or node.initializer is not None):
-                if getattr(node, "device_cached", False):
+                if getattr(node, "device_cached", False) and node.is_embed:
                     # cache rows fill from the PS server on miss; create
                     # the zeros buffer on device — a 512MB h2d of zeros
                     # over a remote tunnel would dominate startup
@@ -638,6 +764,20 @@ class Executor:
             name = "default"
         return self.subexecutors[name].run(
             self, feed_dict, convert_to_numpy_ret_vals)
+
+    def run_batches(self, feed_dicts, name="default",
+                    convert_to_numpy_ret_vals=False):
+        """Run one step per feed dict with a single compiled dispatch
+        (lax.scan block) — same math as sequential ``run`` calls, with
+        per-invocation host overhead amortized by 1/len(feed_dicts).
+        Returns a list of per-step output lists."""
+        sub = self.subexecutors[name]
+        needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
+                    or sub.cached_lookups)
+        if needs_ps:
+            return self.ps_runtime.run_block(
+                sub, feed_dicts, convert_to_numpy_ret_vals)
+        return sub.run_block(self, feed_dicts, convert_to_numpy_ret_vals)
 
     def get_batch_num(self, name="default"):
         return self.subexecutors[name].batch_num

@@ -272,15 +272,17 @@ class OptimizerOp(Op):
                                   dense_shape=gval.dense_shape)
             grad_vals[node] = gval
             param_vals[node] = pval
-            if getattr(node, "device_cached", False) and \
-                    isinstance(gval, IndexedSlices):
-                # HET push accumulator: raw grads scatter-add into HBM
+            if getattr(node, "device_cached", False):
+                # HET push accumulator: raw grads accumulate in HBM
                 # state; the PS runtime drains it to the server every
-                # push_bound steps (ps/runtime.py _drain_device_table)
+                # cache_bound steps (ps/runtime.py drain paths)
                 acc = ectx.state[node]["acc"]
-                ectx.new_state[node] = {"acc": acc.at[
-                    gval.get_flat_indices()].add(
-                        gval.get_dense_rows().astype(acc.dtype))}
+                if isinstance(gval, IndexedSlices):
+                    acc = acc.at[gval.get_flat_indices()].add(
+                        gval.get_dense_rows().astype(acc.dtype))
+                else:
+                    acc = acc + gval.astype(acc.dtype)
+                ectx.new_state[node] = {"acc": acc}
         lr = getattr(ectx, "lr", None)
         if lr is None:
             lr = opt.learning_rate
@@ -310,6 +312,21 @@ class OptimizerOp(Op):
                 # HET device-cache path: the worker optimizer applies the
                 # local sparse update in-graph; accumulated grads drain to
                 # the server from the PS runtime, not via a comm op
+                comm = grad
+            elif (strategy == "PS" and not param.is_embed
+                    and config.device_cache_tables
+                    and config.prefetch and not config.bsp):
+                # unified HET treatment for dense PS params under the
+                # device-cache ASP mode: locally optimizer-updated every
+                # step (never frozen), with raw grads accumulated in HBM
+                # state and drained to the server on the cache cadence —
+                # one protocol for every parameter, zero per-step host
+                # traffic (ps/runtime.py _drain_dense_cached)
+                param.device_cached = True
+                param.stateful = True
+                param.state_shapes = \
+                    lambda shapes, s=tuple(param.shape): {"acc": s}
+                config.ps_dense_cached.append((param, self.optimizer))
                 comm = grad
             elif strategy == "PS" or (strategy == "Hybrid"
                                       and param.is_embed):

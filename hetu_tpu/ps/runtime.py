@@ -61,9 +61,8 @@ def _opt_spec(optimizer):
 
 
 @jax.jit
-def _acc_add(a, b):
-    # pytree-wide sum: one dispatch accumulates every dense PS grad
-    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+def _zeros_like_tree(t):
+    return jax.tree_util.tree_map(jax.numpy.zeros_like, t)
 
 
 class PSRuntime:
@@ -84,15 +83,12 @@ class PSRuntime:
         if config.prefetch and not config.bsp:
             from concurrent.futures import ThreadPoolExecutor
             self._push_pool = ThreadPoolExecutor(max_workers=2)
-        # dense ASP pipeline state (device-cache mode): ONE accumulator
-        # pytree and one in-flight cycle covering every dense PS param —
-        # a single dispatch per step, a single readback per cycle
-        self._async_dense = (bool(config.device_cache_tables)
-                             and self._push_pool is not None)
-        self._dense_acc = None       # {sid: device grad sum}
-        self._dense_count = 0
+        # dense HET pipeline (unified with the embedding cache): dense PS
+        # params are locally optimizer-updated in-graph with grads
+        # accumulated in HBM state (optimizer.backward_hook); the drain
+        # here pushes the sums and, multi-worker, pulls rebased values
+        self._dense_steps = 0
         self._dense_future = None
-        self._dense_params = {}      # sid -> param node
         self._dense_ready = None     # {sid: np value} to swap in
         # step-phase timing (VERDICT: make the residual gap attributable)
         self.times = {"slot_assign": 0.0, "miss_fill": 0.0, "refresh": 0.0,
@@ -115,6 +111,19 @@ class PSRuntime:
         for entry in self.config.device_cache_tables:
             if self._register_device_table(entry):
                 fresh = True
+        for param, opt in self.config.ps_dense_cached:
+            if param.id in self.registered:
+                continue
+            opt_name, lrs = _opt_spec(opt)
+            self.client.init_tensor(param.id, tuple(param.shape), kind=0,
+                                    opt=opt_name, lrs=lrs)
+            sid = str(param.id)
+            value = self.executor.params.get(sid)
+            if value is None:
+                value = param.initial_value(seed=self.config.seed)
+            self.client.set_param(param.id, np.asarray(value))
+            self.registered.add(param.id)
+            fresh = True
         if fresh and self.config.bsp:
             self.client.barrier()
 
@@ -223,11 +232,11 @@ class PSRuntime:
         if topo_set is None:
             topo_set = sub._topo_set = set(sub.topo_order)
 
-        # swap in dense parameters refreshed by a completed ASP cycle
+        # swap in dense parameters rebased by a completed drain cycle
+        # (multi-worker: the server value folds the other workers' pushes)
         ready, self._dense_ready = self._dense_ready, None
         if ready:
-            for sid, value in ready.items():
-                param = self._dense_params[sid]
+            for sid, (param, value) in ready.items():
                 if sid in executor.params:
                     executor.params[sid] = jax.device_put(
                         value.reshape(param.shape))
@@ -360,7 +369,6 @@ class PSRuntime:
                     self._drain_device_table(rt, wait=self.config.bsp)
 
         # 3. push PS grads / pull updated params
-        dense_grads = {}
         for op, g in zip(sub.ps_ops, ps_grads):
             param = op.parameter
             tid = param.id
@@ -380,10 +388,6 @@ class PSRuntime:
                 self._push_sparse(param, g, nworkers)
                 client.wait(tid)
                 self.times["sync_push"] += time.perf_counter() - t0
-            elif self._async_dense:
-                sid = str(param.id)
-                dense_grads[sid] = g
-                self._dense_params[sid] = param
             else:
                 t0 = time.perf_counter()
                 grad = np.asarray(jax.device_get(g)).ravel()
@@ -397,24 +401,12 @@ class PSRuntime:
                         new_value.reshape(param.shape))
                 self.times["sync_push"] += time.perf_counter() - t0
 
-        if dense_grads:
+        # 3b. dense HET drain cadence (grads already accumulated in-graph)
+        if self.config.ps_dense_cached and sub.training:
             t0 = time.perf_counter()
-            self._dense_acc = (dense_grads if self._dense_acc is None
-                               else _acc_add(self._dense_acc, dense_grads))
-            self._dense_count += 1
-            fut = self._dense_future
-            # cycle on the same cadence as cache drains: background
-            # transfers share one host link with the dispatch stream, so
-            # their sustained bandwidth is paced, not continuous
-            if self._dense_count >= max(1, self.config.cache_bound) and \
-                    (fut is None or fut.done()):
-                if fut is not None:
-                    fut.result()        # surface cycle exceptions
-                self._dense_future = self._push_pool.submit(
-                    self._dense_cycle, self._dense_acc,
-                    self._dense_count, nworkers)
-                self._dense_acc = None
-                self._dense_count = 0
+            self._dense_steps += 1
+            if self._dense_steps >= max(1, self.config.cache_bound):
+                self._drain_dense_cached(nworkers)
             self.times["dense"] += time.perf_counter() - t0
 
         # 4. synchronization discipline: BSP barrier or ASP free-running
@@ -434,6 +426,131 @@ class PSRuntime:
                 results.append(np.asarray(out))
             else:
                 results.append(nd.NDArray(out, None))
+        return results
+
+    # ------------------------------------------------------------------
+    def run_block(self, sub, feed_dicts, convert_to_numpy_ret_vals=False):
+        """``len(feed_dicts)`` steps in ONE dispatch for device-cached
+        graphs: slots for every step are assigned up front (misses fill
+        before the block; pins persist across the whole block so no
+        in-block row is evicted), feeds stack into single transfers, and
+        the compiled lax.scan runs the steps back-to-back on device.
+        Falls back to per-step run_step for host-path PS graphs and BSP
+        (whose barrier is per-step by definition)."""
+        if (sub.ps_lookups or sub.ps_pull_ops or sub.ps_ops
+                or self.config.bsp):
+            return [self.run_step(sub, fd, convert_to_numpy_ret_vals)
+                    for fd in feed_dicts]
+        executor = self.executor
+        client = self.client
+        nsteps = len(feed_dicts)
+        cached = self._cached_for(sub)
+
+        ready, self._dense_ready = self._dense_ready, None
+        if ready:
+            for sid, (param, value) in ready.items():
+                if sid in executor.params:
+                    executor.params[sid] = jax.device_put(
+                        value.reshape(param.shape))
+
+        topo_set = getattr(sub, "_topo_set", None)
+        if topo_set is None:
+            topo_set = sub._topo_set = set(sub.topo_order)
+        feed_map = {}
+        first_map = {}
+        for node in (feed_dicts[0] or {}):
+            if node not in topo_set:
+                continue     # e.g. raw ids replaced by the slots feed
+            feed_map[node], first_map[node] = sub._stack_feed(
+                [fd[node] for fd in feed_dicts])
+        for dl in sub.dataloader_ops:
+            stacked = np.stack([np.asarray(dl.get_arr(sub.name))
+                                for _ in range(nsteps)])
+            feed_map[dl] = sub._ingest_stacked(stacked)
+            first_map[dl] = stacked[0]
+
+        # per-step ids, fetched once per source (a dataloader shared by
+        # two cached tables must advance once per step, not once per
+        # table — mirrors run_step's host_feeds memoization)
+        from ..dataloader import DataloaderOp, GNNDataLoaderOp
+        ids_block = {}
+        for rt, ids_node, slots_node in cached:
+            if ids_node in ids_block:
+                continue
+            rows = []
+            for fd in feed_dicts:
+                if ids_node in fd:
+                    rows.append(np.asarray(fd[ids_node]))
+                elif isinstance(ids_node, (DataloaderOp, GNNDataLoaderOp)):
+                    rows.append(np.asarray(ids_node.get_arr(sub.name)))
+                else:
+                    raise RuntimeError(
+                        "device-cached lookup needs host ids per step")
+            ids_block[ids_node] = rows
+
+        note = []
+        for rt, ids_node, slots_node in cached:
+            t0 = time.perf_counter()
+            slot_rows = []
+            for ids in ids_block[ids_node]:
+                slots, miss_ids, miss_slots, uniq_slots = rt.assign(
+                    ids, functools.partial(self._drain_device_table, rt,
+                                           wait=True))
+                self.times["slot_assign"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                if len(miss_ids):
+                    fut = rt._drain_future
+                    inflight = getattr(rt, "_inflight_ids", None)
+                    if fut is not None and not fut.done() and \
+                            inflight is not None and \
+                            np.isin(miss_ids, inflight).any():
+                        fut.result()
+                        rt._drain_future = None
+                    rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
+                    executor.params[rt.cache_sid] = pad_fill(
+                        executor.params[rt.cache_sid], miss_slots, rows,
+                        rt.capacity)
+                    self.times["miss_fill"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                if rt.nworkers > 1:
+                    # bounded-staleness refresh, same as run_step
+                    uniq_ids = rt.id_of[uniq_slots]
+                    fill_slots, fill_rows = rt.stale_check(uniq_ids,
+                                                           uniq_slots)
+                    if fill_slots is not None:
+                        executor.params[rt.cache_sid] = pad_fill(
+                            executor.params[rt.cache_sid], fill_slots,
+                            fill_rows, rt.capacity)
+                    self.times["refresh"] += time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                slot_rows.append(slots)
+                if sub.training:
+                    note.append((rt, uniq_slots))
+            feed_map[slots_node] = sub._ingest_stacked(np.stack(slot_rows))
+            first_map[slots_node] = slot_rows[0]
+            self.times["slot_assign"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        results = sub._dispatch_block(executor, feed_map, first_map,
+                                      nsteps, convert_to_numpy_ret_vals)
+        self.times["dispatch"] += time.perf_counter() - t0
+
+        stepped_tables = set()
+        for rt, uniq_slots in note:
+            rt.note_update(uniq_slots)
+            stepped_tables.add(rt)
+        for rt, _, _ in cached:
+            rt.release_pins()
+        for rt in stepped_tables:
+            for _ in range(nsteps):
+                rt.note_step()
+            if rt.steps_since_drain >= rt.push_bound:
+                self._drain_device_table(rt)
+        if self.config.ps_dense_cached and sub.training:
+            self._dense_steps += nsteps
+            if self._dense_steps >= max(1, self.config.cache_bound):
+                self._drain_dense_cached(max(1, client.nworkers))
+
         return results
 
     # ------------------------------------------------------------------
@@ -475,22 +592,54 @@ class PSRuntime:
             push()
         self.times["drain_submit"] += time.perf_counter() - t0
 
-    def _dense_cycle(self, acc_dev, count, nworkers):
-        """One ASP dense round trip (push pool): readback every dense
-        grad sum in one device_get, DDPushPull each through the server
-        optimizer, stage the refreshed parameters for the next step's
-        swap-in."""
-        host = jax.device_get(acc_dev)
-        ready = {}
-        for sid, g in host.items():
-            grad = np.asarray(g).ravel()
-            if nworkers > 1:
-                grad = grad / nworkers
-            tid = self._dense_params[sid].id
-            ready[sid] = self.client.dd_pushpull(tid, grad)
-        for sid in host:
-            self.client.wait(self._dense_params[sid].id)
-        self._dense_ready = ready
+    def _drain_dense_cached(self, nworkers, wait=False):
+        """Drain the dense HET accumulators: claim each param's HBM grad
+        sum (replacing it with zeros — two async dispatches), then push
+        the sums through the server optimizer on the push pool.
+        Multi-worker, the server value is pulled back and staged to
+        replace the local param (bounded-staleness rebase)."""
+        fut = self._dense_future
+        if fut is not None:
+            if not fut.done() and not wait:
+                return
+            fut.result()
+            self._dense_future = None
+        executor = self.executor
+        accs, params = {}, {}
+        for param, _opt in self.config.ps_dense_cached:
+            sid = str(param.id)
+            st = executor.state.get(sid)
+            if st is None:
+                continue
+            accs[sid] = st["acc"]
+            params[sid] = param
+        if not accs:
+            return
+        zeros = _zeros_like_tree(accs)
+        for sid in accs:
+            executor.state[sid] = {"acc": zeros[sid]}
+        self._dense_steps = 0
+
+        def cycle():
+            host = jax.device_get(accs)
+            for sid, g in host.items():
+                grad = np.asarray(g).ravel()
+                if nworkers > 1:
+                    grad = grad / nworkers
+                self.client.push(params[sid].id, grad)
+            ready = {}
+            for sid, param in params.items():
+                self.client.wait(param.id)
+                if nworkers > 1:
+                    ready[sid] = (param, self.client.pull(
+                        param.id, (int(np.prod(param.shape)),)))
+            if ready:
+                self._dense_ready = ready
+
+        if self._push_pool is not None and not wait:
+            self._dense_future = self._push_pool.submit(cycle)
+        else:
+            cycle()
 
     # ------------------------------------------------------------------
     def _push_sparse(self, param, g, nworkers):
@@ -522,15 +671,12 @@ class PSRuntime:
         cache drains, dense ASP cycles) has reached the server."""
         for rt in self.device_tables.values():
             self._drain_device_table(rt, wait=True)
+        if self.config.ps_dense_cached:
+            self._drain_dense_cached(max(1, self.client.nworkers),
+                                     wait=True)
         if self._dense_future is not None:
             self._dense_future.result()
             self._dense_future = None
-        if self._dense_acc is not None:
-            # un-flushed dense accumulation: one final synchronous cycle
-            acc, self._dense_acc = self._dense_acc, None
-            count, self._dense_count = self._dense_count, 0
-            if count:
-                self._dense_cycle(acc, count, max(1, self.client.nworkers))
         for f in self._pending_push:
             f.result()
         self._pending_push.clear()
