@@ -190,6 +190,62 @@ def bench_wdl_ps():
         ps_server.shutdown_server()
 
 
+def bench_wdl_hybrid():
+    """Wide&Deep Criteo, Hybrid mode: dense params in-graph (AllReduce
+    across chips; local on one), embedding via the PS device cache — the
+    reference's flagship CTR deployment (executor.py:204-209)."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    from hetu_tpu.models.ctr import wdl_criteo
+    from hetu_tpu.ps import server as ps_server
+    from hetu_tpu.ps import client as ps_client
+
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    try:
+        batch = 128
+        rng = np.random.RandomState(0)
+        dense = ht.Variable("dense_input", trainable=False)
+        sparse = ht.Variable("sparse_input", trainable=False)
+        y_ = ht.Variable("y_", trainable=False)
+        loss, y, y_, train_op = wdl_criteo(
+            dense, sparse, y_, feature_dimension=1_000_000)
+        exe = Executor([loss, train_op], comm_mode="Hybrid",
+                       cstable_policy="Device", cache_bound=50)
+        ncycle = 100
+        zipf = (rng.zipf(1.3, size=(ncycle, batch, 26)) - 1) % 1_000_000
+        dense_in = rng.randn(batch, 13).astype("f")
+        y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+        kblock = 20
+
+        def block(i0):
+            return [{dense: dense_in, sparse: zipf[(i0 + j) % ncycle],
+                     y_: y_in} for j in range(kblock)]
+
+        for i0 in range(0, ncycle + kblock, kblock):
+            out = exe.run_batches(block(i0))
+        out[-1][0].asnumpy()
+        steps = 300
+        sps = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for i0 in range(0, steps, kblock):
+                out = exe.run_batches(block(i0))
+            out[-1][0].asnumpy()
+            sps = max(sps, steps * batch / (time.perf_counter() - t0))
+        emit("wdl_criteo_hybrid_samples_per_sec_per_chip", sps,
+             "samples/sec/chip", sps / WDL_BASELINE_SPS)
+        exe.close()
+    finally:
+        client.shutdown_servers()
+        ps_client.close_default_client()
+        ps_server.shutdown_server()
+
+
 def bench_gcn():
     """Full-batch GCN at OGB-arxiv scale (169k nodes, ~1.2M edges):
     epoch (= full-graph step) time."""
@@ -281,12 +337,59 @@ def bench_bert():
          tps / BERT_BASELINE_TPS)
 
 
+def bench_bert_long_seq():
+    """Long-context single chip: BERT-small at S=2048 through the Pallas
+    flash path (the memory profile ring attention extends across chips —
+    sequence parallelism itself needs >1 real chip, validated on the
+    virtual mesh by tests/test_sequence_parallel.py)."""
+    import jax.numpy as jnp
+
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    import hetu_tpu.models as M
+    from __graft_entry__ import _feed_values
+
+    vocab, seq_len, batch = 30522, 2048, 8
+    cfg = M.BertConfig(
+        vocab_size=vocab, hidden_size=512, num_hidden_layers=4,
+        num_attention_heads=8, intermediate_size=2048,
+        max_position_embeddings=seq_len, use_flash_attention=True)
+    model = M.BertForPreTraining(cfg)
+    input_ids = ht.Variable("input_ids", trainable=False)
+    token_type_ids = ht.Variable("token_type_ids", trainable=False)
+    attention_mask = ht.Variable("attention_mask", trainable=False)
+    mlm_labels = ht.Variable("masked_lm_labels", trainable=False)
+    nsp_label = ht.Variable("next_sentence_label", trainable=False)
+    _, _, mlm_loss, nsp_loss = model(input_ids, token_type_ids,
+                                     attention_mask, mlm_labels, nsp_label)
+    loss = ht.reduce_mean_op(mlm_loss, [0, 1]) + \
+        ht.reduce_mean_op(nsp_loss, [0])
+    train_op = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
+    exe = Executor([loss, train_op], dtype=jnp.bfloat16)
+    feed_nodes = (input_ids, token_type_ids, attention_mask, mlm_labels,
+                  nsp_label)
+    feeds = _pin(_feed_values(feed_nodes, batch, seq_len, vocab))
+    for _ in range(3):
+        out = exe.run(feed_dict=feeds)
+    out[0].asnumpy()
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(feed_dict=feeds)
+    out[0].asnumpy()
+    dt = time.perf_counter() - t0
+    tps = steps * batch * seq_len / dt
+    emit("bert_s2048_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
+         tps / BERT_BASELINE_TPS)
+
+
 def main():
     import gc
 
     import jax
 
-    for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps, bench_gcn,
+    for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
+               bench_wdl_hybrid, bench_gcn, bench_bert_long_seq,
                bench_bert):
         try:
             fn()

@@ -40,7 +40,8 @@ class BertConfig:
                  attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
                  initializer_range=0.02, output_hidden_states=False,
-                 batch_size=None, use_flash_attention=False):
+                 batch_size=None, use_flash_attention=False,
+                 sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -55,6 +56,10 @@ class BertConfig:
         self.output_hidden_states = output_hidden_states
         self.batch_size = batch_size        # unused; kept for parity
         self.use_flash_attention = use_flash_attention
+        # sequence/context parallelism: attention runs as a ring over the
+        # mesh's "sp" axis (parallel/ring.py) — per-chip attention memory
+        # O(S/n · D); falls back to the fused path off-mesh
+        self.sequence_parallel = sequence_parallel
 
 
 def _act(name):
@@ -173,6 +178,8 @@ class BertSelfAttention:
         self.hidden_size = config.hidden_size
         self.seq_len = config.max_position_embeddings
         self.use_flash = config.use_flash_attention
+        self.sequence_parallel = getattr(config, "sequence_parallel",
+                                         False)
         self.query = Linear(config.hidden_size, config.hidden_size,
                             name=name + "_query")
         self.key = Linear(config.hidden_size, config.hidden_size,
@@ -193,7 +200,14 @@ class BertSelfAttention:
         k = self._heads(self.key(hidden_states, shape3), seq_len)
         v = self._heads(self.value(hidden_states, shape3), seq_len)
 
-        if self.use_flash:
+        if self.sequence_parallel:
+            # ring attention over the "sp" mesh axis; probs-dropout is
+            # skipped exactly as on the flash path
+            from ..ops.attention import ring_attention_op
+            context = ring_attention_op(q, k, v, attention_mask,
+                                        sm_scale=1.0 / float(
+                                            np.sqrt(self.head_size)))
+        elif self.use_flash:
             # NOTE: the fused kernel keeps attention probs in VMEM and
             # does not implement probs-dropout; attention_probs_dropout
             # is therefore skipped on this path (dropout on the output

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from ..graph.node import Op
 
-__all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference"]
+__all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference",
+           "ring_attention_op", "RingAttentionOp"]
 
 
 def attention_reference(q, k, v, mask, sm_scale):
@@ -124,3 +125,75 @@ class _FlashAttentionGradOp(Op):
 def flash_attention_op(q, k, v, mask=None, sm_scale=1.0, causal=False,
                        ctx=None):
     return FlashAttentionOp(q, k, v, mask, sm_scale, causal, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# sequence parallelism (SURVEY §5 capability): ring attention as a graph op
+# ---------------------------------------------------------------------------
+
+def _sp_mesh(ectx):
+    """The session mesh when it carries a sequence-parallel axis."""
+    mesh = getattr(getattr(ectx, "config", None), "mesh", None)
+    if mesh is not None and "sp" in mesh.axis_names:
+        return mesh
+    return None
+
+
+class RingAttentionOp(FlashAttentionOp):
+    """Sequence-parallel attention over [B, H, S, D]: the sequence dim
+    shards over the mesh's "sp" axis and K/V shards rotate around the
+    ICI ring with online-softmax merging (parallel/ring.py). Forward AND
+    backward run sharded — per-chip attention memory is O(S/n · D), the
+    long-context scaling the reference lacks (SURVEY §5).
+
+    Falls back to the fused single-device path when the session mesh has
+    no "sp" axis, so models declare sequence parallelism once and run
+    anywhere."""
+
+    def compute(self, input_vals, ectx):
+        mesh = _sp_mesh(ectx)
+        if mesh is None:
+            return super().compute(input_vals, ectx)
+        from ..parallel.ring import ring_attention_sharded
+        q, k, v = input_vals[:3]
+        mask = input_vals[3] if self.has_mask else None
+        return ring_attention_sharded(q, k, v, mesh, axis_name="sp",
+                                      sm_scale=self.sm_scale, mask=mask)
+
+    def gradient(self, output_grad):
+        grads = [_RingAttentionGradOp(self, output_grad, i,
+                                      ctx=self.raw_ctx)
+                 for i in range(3)]
+        if self.has_mask:
+            grads.append(None)
+        return grads
+
+
+class _RingAttentionGradOp(_FlashAttentionGradOp):
+    """dq/dk/dv through the ring itself (ppermute transposes to the
+    reverse rotation), so the backward is sequence-sharded too."""
+
+    def compute(self, input_vals, ectx):
+        mesh = _sp_mesh(ectx)
+        if mesh is None:
+            return super().compute(input_vals, ectx)
+        from ..parallel.ring import ring_attention_sharded
+        fwd = self.forward_op
+        nin = 4 if fwd.has_mask else 3
+        q, k, v = input_vals[:3]
+        mask = input_vals[3] if fwd.has_mask else None
+        dy = input_vals[nin]
+        cache_key = ("ringattn_vjp", fwd.id)
+        if cache_key not in ectx.cache:
+            def f(q_, k_, v_):
+                return ring_attention_sharded(
+                    q_, k_, v_, mesh, axis_name="sp",
+                    sm_scale=fwd.sm_scale, mask=mask)
+            _, vjp = jax.vjp(f, q, k, v)
+            ectx.cache[cache_key] = vjp(dy)
+        return ectx.cache[cache_key][self.which]
+
+
+def ring_attention_op(q, k, v, mask=None, sm_scale=1.0, ctx=None):
+    """Sequence-parallel (ring) attention; see RingAttentionOp."""
+    return RingAttentionOp(q, k, v, mask, sm_scale, causal=False, ctx=ctx)

@@ -1,0 +1,123 @@
+"""User-reachable sequence parallelism (SURVEY §5 capability):
+``ht.ring_attention_op`` and ``BertConfig(sequence_parallel=True)`` lower
+to ring attention over the mesh's "sp" axis (parallel/ring.py), forward
+and backward both sequence-sharded."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor, HetuConfig
+
+
+def _sp_mesh(n=8):
+    return Mesh(np.asarray(jax.devices()[:n]), axis_names=("sp",))
+
+
+def test_ring_attention_op_matches_fused():
+    """ring_attention_op on an 8-way sp mesh == fused single-device
+    attention, including gradients through a training step."""
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 2, 256, 8
+    qv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    kv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    vv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+
+    def build(op):
+        q = ht.Variable("sp_q", value=qv)
+        k = ht.Variable("sp_k", value=kv)
+        v = ht.Variable("sp_v", value=vv)
+        out = op(q, k, v, sm_scale=0.35)
+        loss = ht.reduce_mean_op(
+            ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+        train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        return loss, train, (q, k, v)
+
+    loss, train, nodes = build(ht.flash_attention_op)
+    ref = Executor([loss, train])
+    want = [float(ref.run(feed_dict={},
+                          convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    want_q = np.asarray(ref.params[str(nodes[0].id)])
+
+    loss2, train2, nodes2 = build(ht.ring_attention_op)
+    config = HetuConfig(eval_node_list=[loss2, train2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2, train2]}, config=config)
+    got = [float(exe.run(feed_dict={},
+                         convert_to_numpy_ret_vals=True)[0])
+           for _ in range(3)]
+    got_q = np.asarray(exe.params[str(nodes2[0].id)])
+
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(got_q, want_q, rtol=1e-3, atol=1e-5)
+
+
+def test_ring_attention_op_fallback_off_mesh():
+    """Without an "sp" mesh axis the op runs the fused path — models can
+    declare sequence parallelism unconditionally."""
+    rng = np.random.RandomState(1)
+    q = ht.Variable("f_q", value=rng.randn(1, 2, 64, 8).astype("f"))
+    k = ht.Variable("f_k", value=rng.randn(1, 2, 64, 8).astype("f"))
+    v = ht.Variable("f_v", value=rng.randn(1, 2, 64, 8).astype("f"))
+    out = ht.ring_attention_op(q, k, v, sm_scale=0.35)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+    exe = Executor([loss])
+    val = float(exe.run(feed_dict={},
+                        convert_to_numpy_ret_vals=True)[0])
+    assert np.isfinite(val)
+
+
+def test_bert_sequence_parallel_long_seq():
+    """BertConfig(sequence_parallel=True) at S=2048 on the 8-device sp
+    mesh: training step runs, loss matches the non-SP model bit-for-bit
+    (same name-seeded weights)."""
+    import hetu_tpu.models as M
+
+    seq_len, vocab, batch = 2048, 128, 2
+
+    def build(sp):
+        cfg = M.BertConfig(
+            vocab_size=vocab, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=seq_len, sequence_parallel=sp,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        model = M.BertForPreTraining(cfg)
+        input_ids = ht.Variable("input_ids", trainable=False)
+        token_type_ids = ht.Variable("token_type_ids", trainable=False)
+        attention_mask = ht.Variable("attention_mask", trainable=False)
+        mlm_labels = ht.Variable("masked_lm_labels", trainable=False)
+        nsp_label = ht.Variable("next_sentence_label", trainable=False)
+        _, _, mlm_loss, nsp_loss = model(
+            input_ids, token_type_ids, attention_mask, mlm_labels,
+            nsp_label)
+        loss = ht.reduce_mean_op(mlm_loss, [0, 1]) + \
+            ht.reduce_mean_op(nsp_loss, [0])
+        train = ht.optim.SGDOptimizer(0.01).minimize(loss)
+        feeds = (input_ids, token_type_ids, attention_mask, mlm_labels,
+                 nsp_label)
+        return loss, train, feeds
+
+    rng = np.random.RandomState(0)
+    values = {
+        "input_ids": rng.randint(0, vocab, (batch, seq_len)),
+        "token_type_ids": rng.randint(0, 2, (batch, seq_len)),
+        "attention_mask": np.ones((batch, seq_len), np.float32),
+        "masked_lm_labels": rng.randint(0, vocab, (batch, seq_len)),
+        "next_sentence_label": rng.randint(0, 2, (batch,)),
+    }
+
+    loss, train, feeds = build(sp=False)
+    ref = Executor([loss, train])
+    fd = {n: values[n.name] for n in feeds}
+    want = float(ref.run(feed_dict=fd,
+                         convert_to_numpy_ret_vals=True)[0])
+
+    loss2, train2, feeds2 = build(sp=True)
+    config = HetuConfig(eval_node_list=[loss2, train2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2, train2]}, config=config)
+    fd2 = {n: values[n.name] for n in feeds2}
+    got = float(exe.run(feed_dict=fd2,
+                        convert_to_numpy_ret_vals=True)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-4)
