@@ -33,6 +33,11 @@ def attention_reference(q, k, v, mask, sm_scale):
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
+# sequence length above which the fused Pallas backward beats XLA's
+# composed vjp (below it the S^2 intermediates fit on-chip anyway)
+FUSED_BWD_MIN_SEQ = 512
+
+
 def _use_pallas():
     try:
         if jax.default_backend() != "tpu":
@@ -59,8 +64,16 @@ class FlashAttentionOp(Op):
         q, k, v = input_vals[:3]
         mask = input_vals[3] if self.has_mask else None
         if _use_pallas():
-            # causal is a kernel flag; only the padding mask travels
-            from .pallas_attention import flash_attention
+            # causal is a kernel flag; only the padding mask travels.
+            # The logsumexp residual is stashed for the fused backward
+            # (the grad op runs later in the same trace).
+            from .pallas_attention import (flash_attention,
+                                           flash_attention_with_lse)
+            o, lse = flash_attention_with_lse(
+                q, k, v, mask, sm_scale=self.sm_scale, causal=self.causal)
+            if o is not None:
+                ectx.cache[("flash_res", self.id)] = (o, lse)
+                return o
             return flash_attention(q, k, v, mask, sm_scale=self.sm_scale,
                                    causal=self.causal)
         if self.causal:
@@ -101,6 +114,22 @@ class _FlashAttentionGradOp(Op):
         dy = input_vals[nin]
 
         cache_key = ("flashattn_vjp", fwd.id)
+        res = ectx.cache.get(("flash_res", fwd.id))
+        if cache_key not in ectx.cache and res is not None and \
+                q.shape[-2] >= FUSED_BWD_MIN_SEQ:
+            # fused Pallas backward: rebuild score blocks in VMEM from
+            # the forward's logsumexp — the S x S matrices never hit HBM
+            # on the backward either (pallas_attention.py). Below the
+            # threshold the composed vjp wins: XLA fuses the small S^2
+            # intermediates on-chip anyway and the kernels' extra
+            # recompute pass costs more than it saves (measured: S=128
+            # BERT-base 120k tok/s composed vs 100k fused; S=2048
+            # 186k composed vs 226k fused).
+            from .pallas_attention import flash_attention_bwd
+            o, lse = res
+            ectx.cache[cache_key] = flash_attention_bwd(
+                q, k, v, mask, o, lse, dy, sm_scale=fwd.sm_scale,
+                causal=fwd.causal)
         if cache_key not in ectx.cache:
             def f(q_, k_, v_):
                 m = mask

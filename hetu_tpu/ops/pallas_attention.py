@@ -1,15 +1,18 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels (forward + fused backward).
 
 No reference equivalent (the reference composes attention from cublas
-batch-matmuls, examples/nlp/bert/hetu_bert.py:191-227). This is the
+batch-matmuls, examples/nlp/bert/hetu_bert.py:191-227). Forward is the
 blocked online-softmax kernel: per (batch*head, q-block) program, stream
 K/V blocks through VMEM keeping a running (max, sum, accumulator) — the
 [S, S] score matrix never exists in HBM, so attention memory is O(S·D)
 instead of O(S²) and the MXU stays fed from VMEM.
 
-Backward currently rematerializes through the composed-XLA reference
-(ops/attention.py _FlashAttentionGradOp) — the standard recompute
-policy; a fused backward kernel is a later optimization.
+Backward is the standard recompute form: the forward also emits the
+per-row logsumexp L, and two kernels rebuild score blocks in VMEM —
+one gridded over K blocks producing dK/dV, one over Q blocks producing
+dQ — so the S×S matrices never exist in HBM on the backward pass either
+(the property training needs for long context; D = rowsum(dO ∘ O) is a
+cheap XLA elementwise reduce outside the kernels).
 """
 from __future__ import annotations
 
@@ -20,12 +23,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "flash_attention_bwd"]
 
 NEG_INF = -1e30
+LANES = 128      # TPU minor-dim tile: residual vectors store lane-tiled
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale,
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *, sm_scale,
                 block_k, seq_len, causal, block_q):
     q = q_ref[0].astype(jnp.float32)          # [block_q, d]
     num_kb = seq_len // block_k
@@ -63,8 +68,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, sm_scale,
     m0 = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
     acc0 = jnp.zeros(q.shape, jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    if l_ref is not None:
+        # per-row logsumexp, the backward's softmax residual — written
+        # lane-tiled [block_q, 128] (TPU blocks need 128-lane minors)
+        l_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANES))
 
 
 def _block_sizes(seq_len, head_dim):
@@ -75,6 +84,13 @@ def _block_sizes(seq_len, head_dim):
     while seq_len % bk:
         bk //= 2
     return max(bq, 8), max(bk, 8)
+
+
+def _supported(s, d, block_q, block_k):
+    # the grid covers s // block only when s divides evenly; max(bq, 8)
+    # can break that for s % 8 != 0 (e.g. s=260), which would leave tail
+    # rows unwritten — callers fall back to the composed reference
+    return not (s < 8 or d % 8 or s % block_q or s % block_k)
 
 
 def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
@@ -90,10 +106,7 @@ def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
         interpret = INTERPRET
     b, h, s, d = q.shape
     block_q, block_k = _block_sizes(s, d)
-    # the grid covers s // block only when s divides evenly; max(bq, 8)
-    # can break that for s % 8 != 0 (e.g. s=260), which would leave tail
-    # rows unwritten — fall back to the composed reference instead
-    if s < 8 or d % 8 or s % block_q or s % block_k:
+    if not _supported(s, d, block_q, block_k):
         from .attention import attention_reference
         m = mask
         if causal:
@@ -101,11 +114,35 @@ def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
                               NEG_INF)[None, None]
             m = cmask if m is None else m + cmask
         return attention_reference(q, k, v, m, sm_scale)
-    return _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret)
+    out, _ = _flash_attention_jit(q, k, v, mask, sm_scale, causal,
+                                  interpret)
+    return out
+
+
+def flash_attention_with_lse(q, k, v, mask=None, sm_scale=1.0,
+                             causal=False, interpret=None):
+    """(output, logsumexp [B, H, S]) — the pair the fused backward needs.
+    Returns (None, None) on shapes the kernel does not support; callers
+    then take the composed path for both directions."""
+    if interpret is None:
+        interpret = INTERPRET
+    b, h, s, d = q.shape
+    block_q, block_k = _block_sizes(s, d)
+    if not _supported(s, d, block_q, block_k):
+        return None, None
+    out, lse = _flash_attention_jit(q, k, v, mask, sm_scale, causal,
+                                    interpret)
+    return out, lse
 
 
 # tests flip this to exercise the kernel without a TPU backend
 INTERPRET = False
+
+
+def _mask_rows(mask, b, h, s):
+    """[B, 1, 1, S]-broadcastable additive mask -> [B, 1, S] rows."""
+    return jnp.broadcast_to(mask, (b, 1, 1, s)).reshape(
+        b, 1, s).astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "causal",
@@ -126,26 +163,220 @@ def _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret):
     ]
     args = [qr, kr, vr]
     if mask is not None:
-        mr = jnp.broadcast_to(mask, (b, 1, 1, s)).reshape(
-            b, 1, s).astype(jnp.float32)
         in_specs.append(
             pl.BlockSpec((1, 1, s), lambda bh, qi, _h=h: (bh // _h, 0, 0)))
-        args.append(mr)
+        args.append(_mask_rows(mask, b, h, s))
         kernel = functools.partial(
             _fwd_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=s,
             causal=causal, block_q=block_q)
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref):
-            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref,
+        def kernel(q_ref, k_ref, v_ref, o_ref, l_ref):
+            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, l_ref,
                         sm_scale=sm_scale, block_k=block_k, seq_len=s,
                         causal=causal, block_q=block_q)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32)],
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s)
+
+
+# ---------------------------------------------------------------------------
+# fused backward (recompute form)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
+                    dk_ref, dv_ref, *, sm_scale, block_q, block_k,
+                    seq_len, causal):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    num_qb = seq_len // block_q
+    start = (kj * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = l_ref[0, pl.ds(i * block_q, block_q), 0:1][:, 0]
+        dd = d_ref[0, pl.ds(i * block_q, block_q), 0:1][:, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0, pl.ds(kj * block_k, block_k)][None, :]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])         # [block_q, block_k]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_qb, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
+                   dq_ref, *, sm_scale, block_q, block_k, seq_len,
+                   causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    do = do_ref[0].astype(jnp.float32)
+    lse = l_ref[0, :, 0:1][:, 0]              # [block_q] (lane-tiled in)
+    dd = d_ref[0, :, 0:1][:, 0]
+    num_kb = seq_len // block_k
+    if causal:
+        num_kb = jnp.minimum(num_kb,
+                             pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "causal",
+                                             "interpret"))
+def _flash_attention_bwd_jit(q, k, v, mask, o, lse, do, sm_scale, causal,
+                             interpret):
+    b, h, s, d = q.shape
+    block_q, block_k = _block_sizes(s, d)
+    grid_kv = (b * h, s // block_k)
+    grid_q = (b * h, s // block_q)
+
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    dor = do.reshape(b * h, s, d)
+    # residual vectors travel lane-tiled (TPU 128-lane minors)
+    lser = jnp.broadcast_to(lse.reshape(b * h, s)[:, :, None],
+                            (b * h, s, LANES))
+    # D = rowsum(dO * O): cheap XLA reduce, shared by both kernels
+    dr = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1).reshape(b * h, s)[:, :, None],
+        (b * h, s, LANES))
+
+    full = lambda bh, i: (bh, 0, 0)         # noqa: E731
+    in_specs_kv = [
+        pl.BlockSpec((1, s, d), full),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, s, d), full),
+        pl.BlockSpec((1, s, LANES), full),
+        pl.BlockSpec((1, s, LANES), full),
+    ]
+    in_specs_q = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, s, d), full),
+        pl.BlockSpec((1, s, d), full),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+    ]
+    args = [qr, kr, vr, dor, lser, dr]
+    if mask is not None:
+        mrow = _mask_rows(mask, b, h, s)
+        mask_spec = pl.BlockSpec((1, 1, s),
+                                 lambda bh, i, _h=h: (bh // _h, 0, 0))
+        in_specs_kv.append(mask_spec)
+        in_specs_q.append(mask_spec)
+        args = args + [mrow]
+        kv_kernel = functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, block_q=block_q,
+            block_k=block_k, seq_len=s, causal=causal)
+        q_kernel = functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, block_q=block_q,
+            block_k=block_k, seq_len=s, causal=causal)
+    else:
+        def kv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+                      dk_ref, dv_ref):
+            _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+                            None, dk_ref, dv_ref, sm_scale=sm_scale,
+                            block_q=block_q, block_k=block_k, seq_len=s,
+                            causal=causal)
+
+        def q_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref):
+            _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+                           None, dq_ref, sm_scale=sm_scale,
+                           block_q=block_q, block_k=block_k, seq_len=s,
+                           causal=causal)
+
+    dk, dv = pl.pallas_call(
+        kv_kernel,
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        grid=grid_kv,
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj: (bh, kj, 0)),
+        ],
+        interpret=interpret,
+    )(*args)
+    dq = pl.pallas_call(
+        q_kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=grid_q,
+        in_specs=in_specs_q,
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(*args)
+    shape = (b, h, s, d)
+    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
+
+
+def flash_attention_bwd(q, k, v, mask, o, lse, do, sm_scale=1.0,
+                        causal=False, interpret=None):
+    """(dq, dk, dv) via the fused recompute-form kernels. ``lse`` is the
+    forward's logsumexp (flash_attention_with_lse)."""
+    if interpret is None:
+        interpret = INTERPRET
+    return _flash_attention_bwd_jit(q, k, v, mask, o, lse, do, sm_scale,
+                                    causal, interpret)

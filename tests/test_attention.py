@@ -162,3 +162,79 @@ def test_flash_attention_odd_seq_falls_back():
     out = flash_attention(q, k, v, None, sm_scale=0.25, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_fused_backward():
+    """Fused Pallas backward (recompute form) vs jax.grad of the composed
+    reference: no-mask, padding-mask, causal, and both."""
+    from hetu_tpu.ops.pallas_attention import (flash_attention_bwd,
+                                               flash_attention_with_lse)
+
+    for use_mask, causal, s in [(False, False, 64), (True, False, 64),
+                                (False, True, 64), (True, True, 128)]:
+        q, k, v = _qkv(s=s, seed=11 + s)
+        mask = _mask(s=s, valid=s - 10) if use_mask else None
+        o, lse = flash_attention_with_lse(q, k, v, mask, sm_scale=0.25,
+                                          causal=causal, interpret=True)
+        assert o is not None
+        rng = np.random.RandomState(5)
+        dy = jnp.asarray(rng.randn(*q.shape), jnp.float32)
+
+        def f(q_, k_, v_):
+            m = mask
+            if causal:
+                cm = jnp.where(jnp.tril(jnp.ones((s, s), bool)), 0.0,
+                               -1e30)[None, None]
+                m = cm if m is None else m + cm
+            return attention_reference(q_, k_, v_, m, 0.25)
+
+        ref_o, vjp = jax.vjp(f, q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o),
+                                   rtol=2e-5, atol=2e-5)
+        want = vjp(dy)
+        got = flash_attention_bwd(q, k, v, mask, o, lse, dy,
+                                  sm_scale=0.25, causal=causal,
+                                  interpret=True)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} mismatch (mask={use_mask}, "
+                        f"causal={causal})")
+
+
+def test_flash_attention_op_fused_backward_path(monkeypatch):
+    """The graph op routes grads through the fused kernels when the
+    forward stashed its logsumexp residual."""
+    from hetu_tpu.ops import attention as attn_mod
+    from hetu_tpu.ops import pallas_attention as pk
+    from hetu_tpu.ops.attention import (FlashAttentionOp,
+                                        _FlashAttentionGradOp)
+    from hetu_tpu.graph.node import ExecContext
+    import hetu_tpu as ht
+
+    monkeypatch.setattr(attn_mod, "_use_pallas", lambda: True)
+    monkeypatch.setattr(attn_mod, "FUSED_BWD_MIN_SEQ", 0)
+    monkeypatch.setattr(pk, "INTERPRET", True)
+
+    s = 32
+    q, k, v = _qkv(s=s, seed=13)
+    mask = _mask(s=s, valid=s - 6)
+    rng = np.random.RandomState(7)
+    dy = jnp.asarray(rng.randn(*q.shape), jnp.float32)
+
+    ectx = ExecContext(training=True)
+    qn, kn, vn, mn = [ht.Variable(n, trainable=False) for n in "qkvm"]
+    fwd = FlashAttentionOp(qn, kn, vn, mn, sm_scale=0.25)
+    out = fwd.compute([q, k, v, mask], ectx)
+    assert ("flash_res", fwd.id) in ectx.cache
+    dyn = ht.Variable("dy", trainable=False)
+    grads = [_FlashAttentionGradOp(fwd, dyn, i).compute(
+        [q, k, v, mask, dy], ectx) for i in range(3)]
+
+    def f(q_, k_, v_):
+        return attention_reference(q_, k_, v_, mask, 0.25)
+    _, vjp = jax.vjp(f, q, k, v)
+    want = vjp(dy)
+    for g, w in zip(grads, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
