@@ -1,0 +1,5 @@
+"""ONNX interop (reference parity: python/hetu/onnx/)."""
+from .hetu2onnx import export
+from .onnx2hetu import load_onnx
+
+__all__ = ["export", "load_onnx"]

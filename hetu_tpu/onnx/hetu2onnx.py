@@ -1,0 +1,328 @@
+"""hetu graph -> ONNX export.
+
+Reference parity: python/hetu/onnx/hetu2onnx.py + onnx_opset/* (~25
+handlers at opset 9/11). ``export(executor, inputs, outputs, path)``
+walks the forward topo order, maps each op to ONNX nodes, pulls
+parameter values from the executor, and writes a ModelProto through the
+self-contained codec in proto.py (no onnx pip dependency).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.autodiff import find_topo_sort
+from ..ops.variable import PlaceholderOp
+from . import proto
+from .proto import Attribute, Graph, Model, Node, Tensor, ValueInfo
+
+__all__ = ["export"]
+
+OPSET = 11
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class _Exporter:
+    def __init__(self, executor, inputs, outputs):
+        self.executor = executor
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.graph = Graph()
+        self.names = {}
+        self._uid = 0
+
+    def name(self, node):
+        if node not in self.names:
+            self.names[node] = f"{node.name}_{node.id}"
+        return self.names[node]
+
+    def fresh(self, tag):
+        self._uid += 1
+        return f"{tag}_{self._uid}"
+
+    def add(self, op_type, inputs, outputs=None, **attrs):
+        outputs = outputs or [self.fresh(op_type.lower())]
+        self.graph.nodes.append(Node(
+            op_type, inputs, outputs, name=self.fresh(op_type),
+            attrs={k: Attribute(k, v) for k, v in attrs.items()
+                   if v is not None}))
+        return outputs[0]
+
+    def const(self, array, tag="const"):
+        name = self.fresh(tag)
+        self.graph.initializers.append(Tensor(name, np.asarray(array)))
+        return name
+
+    # ------------------------------------------------------------------
+    def run(self):
+        topo = find_topo_sort(self.outputs)
+        feed_set = set(self.inputs)
+        for node in topo:
+            if node in feed_set:
+                shape = tuple(getattr(node, "inferred_shape", None)
+                              or node.shape or ())
+                dt = (proto.TENSOR_INT64
+                      if np.issubdtype(np.dtype(node.dtype), np.integer)
+                      else proto.TENSOR_FLOAT)
+                self.graph.inputs.append(
+                    ValueInfo(self.name(node), dt, shape))
+                continue
+            if isinstance(node, PlaceholderOp):
+                sid = str(node.id)
+                value = self.executor.params.get(sid) \
+                    if self.executor is not None else None
+                if value is None:
+                    value = node.initial_value(
+                        seed=getattr(getattr(self.executor, "config",
+                                             None), "seed", 0))
+                self.graph.initializers.append(
+                    Tensor(self.name(node), np.asarray(value)))
+                continue
+            handler = _HANDLERS.get(type(node).__name__)
+            if handler is None:
+                raise NotImplementedError(
+                    f"no ONNX handler for op {type(node).__name__}")
+            handler(self, node)
+        for out in self.outputs:
+            shape = tuple(getattr(out, "inferred_shape", None) or ())
+            self.graph.outputs.append(
+                ValueInfo(self.name(out), proto.TENSOR_FLOAT, shape))
+        return self.graph
+
+
+# -- handlers ---------------------------------------------------------------
+
+_HANDLERS = {}
+
+
+def handles(*names):
+    def deco(fn):
+        for n in names:
+            _HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+def _in(ex, node, i=0):
+    return ex.name(node.inputs[i])
+
+
+def _simple(op_type):
+    def fn(ex, node):
+        ex.add(op_type, [ex.name(i) for i in node.inputs],
+               [ex.name(node)])
+    return fn
+
+
+for hetu_name, onnx_name in [
+        ("AddOp", "Add"), ("MulOp", "Mul"), ("DivOp", "Div"),
+        ("OppositeOp", "Neg"), ("SqrtOp", "Sqrt"), ("ReluOp", "Relu"),
+        ("SigmoidOp", "Sigmoid"), ("TanhOp", "Tanh"),
+        ("WhereOp", "Where"), ("ExpOp", "Exp"), ("LogOp", "Log"),
+        ("AbsOp", "Abs")]:
+    _HANDLERS[hetu_name] = _simple(onnx_name)
+
+
+@handles("AddByConstOp")
+def _add_const(ex, node):
+    c = ex.const(np.asarray(node.const_attr, np.float32))
+    ex.add("Add", [_in(ex, node), c], [ex.name(node)])
+
+
+@handles("MulByConstOp")
+def _mul_const(ex, node):
+    c = ex.const(np.asarray(node.const_attr, np.float32))
+    ex.add("Mul", [_in(ex, node), c], [ex.name(node)])
+
+
+@handles("MatMulOp")
+def _matmul(ex, node):
+    a, b = _in(ex, node, 0), _in(ex, node, 1)
+    if node.matmul_attr_trans_A:
+        a = ex.add("Transpose", [a], perm=[1, 0])
+    if node.matmul_attr_trans_B:
+        b = ex.add("Transpose", [b], perm=[1, 0])
+    ex.add("MatMul", [a, b], [ex.name(node)])
+
+
+@handles("BatchMatMulOp")
+def _batch_matmul(ex, node):
+    a, b = _in(ex, node, 0), _in(ex, node, 1)
+    rank = len(node.inputs[0].inferred_shape or (0, 0, 0))
+    perm = list(range(rank))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    if node.trans_A:
+        a = ex.add("Transpose", [a], perm=perm)
+    if node.trans_B:
+        b = ex.add("Transpose", [b], perm=perm)
+    ex.add("MatMul", [a, b], [ex.name(node)])
+
+
+@handles("SoftmaxOp")
+def _softmax(ex, node):
+    ex.add("Softmax", [_in(ex, node)], [ex.name(node)], axis=-1)
+
+
+@handles("GeluOp")
+def _gelu(ex, node):
+    # erf-form gelu: 0.5 x (1 + erf(x / sqrt(2)))  (Erf is opset 9+)
+    x = _in(ex, node)
+    inv = ex.const(np.float32(1.0 / np.sqrt(2.0)))
+    half = ex.const(np.float32(0.5))
+    one = ex.const(np.float32(1.0))
+    e = ex.add("Erf", [ex.add("Mul", [x, inv])])
+    ex.add("Mul", [ex.add("Mul", [x, half]),
+                   ex.add("Add", [e, one])], [ex.name(node)])
+
+
+@handles("DropoutOp")
+def _dropout(ex, node):
+    ex.add("Dropout", [_in(ex, node)], [ex.name(node)],
+           ratio=float(1.0 - node.keep_prob))
+
+
+@handles("ArrayReshapeOp")
+def _reshape(ex, node):
+    shape = ex.const(np.asarray(node.output_shape, np.int64), "shape")
+    ex.add("Reshape", [_in(ex, node), shape], [ex.name(node)])
+
+
+@handles("TransposeOp")
+def _transpose(ex, node):
+    perm = node.perm
+    if perm is None:
+        perm = list(reversed(range(len(node.inputs[0].inferred_shape))))
+    ex.add("Transpose", [_in(ex, node)], [ex.name(node)],
+           perm=[int(p) for p in perm])
+
+
+@handles("ConcatOp")
+def _concat(ex, node):
+    ex.add("Concat", [ex.name(i) for i in node.inputs], [ex.name(node)],
+           axis=int(node.axis))
+
+
+@handles("SliceOp")
+def _slice(ex, node):
+    in_shape = node.inputs[0].inferred_shape
+    starts = [int(b) for b in node.begin_pos]
+    ends = [int(b + (in_shape[i] - b if s == -1 else s))
+            for i, (b, s) in enumerate(zip(node.begin_pos,
+                                           node.output_shape))]
+    ex.add("Slice", [_in(ex, node),
+                     ex.const(np.asarray(starts, np.int64), "starts"),
+                     ex.const(np.asarray(ends, np.int64), "ends")],
+           [ex.name(node)])
+
+
+@handles("PadOp")
+def _pad(ex, node):
+    befores = [p[0] for p in node.paddings]
+    afters = [p[1] for p in node.paddings]
+    pads = ex.const(np.asarray(befores + afters, np.int64), "pads")
+    cval = ex.const(np.float32(node.constant_values))
+    ex.add("Pad", [_in(ex, node), pads, cval], [ex.name(node)],
+           mode=node.mode.lower().encode())
+
+
+@handles("ReduceSumOp", "ReduceMeanOp")
+def _reduce(ex, node):
+    op = "ReduceSum" if type(node).__name__ == "ReduceSumOp" \
+        else "ReduceMean"
+    keep = int(bool(node.keepdims[0])) if node.keepdims else 0
+    ex.add(op, [_in(ex, node)], [ex.name(node)],
+           axes=[int(a) for a in node.axes], keepdims=keep)
+
+
+@handles("BroadcastToOp")
+def _broadcastto(ex, node):
+    # ONNX binary ops broadcast numpy-style; materialize with Expand so
+    # the output is standalone-correct
+    shape = ex.const(
+        np.asarray(node.inputs[1].inferred_shape, np.int64), "shape")
+    ex.add("Expand", [_in(ex, node, 0), shape], [ex.name(node)])
+
+
+@handles("Conv2dOp")
+def _conv(ex, node):
+    ph, pw = _pair(node.padding)
+    sh, sw = _pair(node.stride)
+    ex.add("Conv", [_in(ex, node, 0), _in(ex, node, 1)], [ex.name(node)],
+           pads=[ph, pw, ph, pw], strides=[sh, sw])
+
+
+@handles("MaxPool2dOp", "AvgPool2dOp")
+def _pool(ex, node):
+    op = "MaxPool" if type(node).__name__ == "MaxPool2dOp" \
+        else "AveragePool"
+    ph, pw = _pair(node.padding)
+    sh, sw = _pair(node.stride)
+    ex.add(op, [_in(ex, node)], [ex.name(node)],
+           kernel_shape=[node.kernel_H, node.kernel_W],
+           pads=[ph, pw, ph, pw], strides=[sh, sw])
+
+
+@handles("BatchNormalizationOp")
+def _batchnorm(ex, node):
+    # inference form: running stats come from executor state when present
+    sid = str(node.id)
+    state = (ex.executor.state.get(sid, {})
+             if ex.executor is not None else {})
+    c = node.inputs[1].inferred_shape[0]
+    mean = np.asarray(state.get("running_mean", np.zeros(c, np.float32)))
+    var = np.asarray(state.get("running_var", np.ones(c, np.float32)))
+    ex.add("BatchNormalization",
+           [_in(ex, node, 0), _in(ex, node, 1), _in(ex, node, 2),
+            ex.const(mean.ravel(), "mean"), ex.const(var.ravel(), "var")],
+           [ex.name(node)], epsilon=float(node.eps),
+           momentum=float(node.momentum))
+
+
+@handles("EmbeddingLookUp")
+def _embedding(ex, node):
+    ex.add("Gather", [_in(ex, node, 0), _in(ex, node, 1)],
+           [ex.name(node)], axis=0)
+
+
+@handles("OneHotOp")
+def _onehot(ex, node):
+    depth = ex.const(np.asarray(node.num_classes, np.int64), "depth")
+    values = ex.const(np.asarray([0.0, 1.0], np.float32), "values")
+    ex.add("OneHot", [_in(ex, node), depth, values], [ex.name(node)],
+           axis=-1)
+
+
+@handles("BroadcastShapeOp")
+def _broadcast_shape(ex, node):
+    if node.add_axes:
+        raise NotImplementedError(
+            "BroadcastShapeOp with add_axes has no single-op ONNX form")
+    shape = ex.const(np.asarray(node.shape, np.int64), "shape")
+    ex.add("Expand", [_in(ex, node, 0), shape], [ex.name(node)])
+
+
+# ---------------------------------------------------------------------------
+
+def export(executor, inputs, outputs, path, job_name=None):
+    """Serialize the forward graph reaching ``outputs`` as an ONNX model
+    (reference hetu2onnx.export). ``inputs`` are the feed placeholders;
+    trainable parameters become initializers with their current values.
+    Shapes must be known — run one step (or Executor shape inference)
+    first."""
+    sub = None
+    if executor is not None:
+        for s in getattr(executor, "subexecutors", {}).values():
+            sub = s
+            break
+    if sub is not None and getattr(outputs[0], "inferred_shape",
+                                   None) is None:
+        raise RuntimeError("run one step before export so shapes are "
+                           "inferred")
+    ex = _Exporter(executor, inputs, outputs)
+    graph = ex.run()
+    graph.name = job_name or "HetuToOnnx"
+    model = Model(graph, opset=OPSET)
+    model.save(path)
+    return model

@@ -1,0 +1,254 @@
+"""ONNX model -> hetu graph import.
+
+Reference parity: python/hetu/onnx/onnx2hetu.py. ``load_onnx(path)``
+parses a ModelProto (self-contained codec, no onnx pip dependency) and
+rebuilds an executable hetu graph: initializers become parameter
+Variables, graph inputs become feed placeholders, and each node maps
+back through the handler table below (the inverse of hetu2onnx's).
+Returns ``(outputs, feeds)`` — run them with an Executor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..ops.variable import Variable
+from .proto import Model
+
+__all__ = ["load_onnx"]
+
+
+def _attr_ints(node, name, default=()):
+    v = node.attr(name)
+    if v is None:
+        return list(default)
+    return [int(x) for x in (v if isinstance(v, (list, tuple)) else [v])]
+
+
+class _Importer:
+    def __init__(self, model):
+        self.model = model
+        self.env = {}        # onnx name -> hetu node
+        self.consts = {}     # onnx name -> numpy (initializers)
+        self.feeds = []
+
+    def value(self, name):
+        return self.env[name]
+
+    def const(self, name):
+        """Initializer as a raw numpy value (shape/axes operands)."""
+        if name in self.consts:
+            return self.consts[name]
+        raise KeyError(f"expected initializer for {name}")
+
+    def run(self):
+        g = self.model.graph
+        for t in g.initializers:
+            self.consts[t.name] = t.array
+        init_names = set(self.consts)
+        for vi in g.inputs:
+            if vi.name in init_names:
+                continue
+            node = Variable(vi.name, trainable=False)
+            node.shape = tuple(vi.shape)
+            self.env[vi.name] = node
+            self.feeds.append(node)
+        for node in g.nodes:
+            handler = _IMPORTERS.get(node.op_type)
+            if handler is None:
+                raise NotImplementedError(
+                    f"no hetu handler for ONNX op {node.op_type}")
+            handler(self, node)
+        outputs = [self.env[vi.name] for vi in g.outputs]
+        return outputs, self.feeds
+
+    def materialize(self, name):
+        """Name -> hetu node, materializing initializers as Variables."""
+        if name in self.env:
+            return self.env[name]
+        value = self.const(name)
+        node = Variable(name, value=value,
+                        trainable=np.issubdtype(value.dtype,
+                                                np.floating))
+        self.env[name] = node
+        return node
+
+
+_IMPORTERS = {}
+
+
+def imports(*names):
+    def deco(fn):
+        for n in names:
+            _IMPORTERS[n] = fn
+        return fn
+    return deco
+
+
+def _binop(build):
+    def fn(im, node):
+        a = im.materialize(node.inputs[0])
+        b = im.materialize(node.inputs[1])
+        im.env[node.outputs[0]] = build(a, b)
+    return fn
+
+
+def _unop(build):
+    def fn(im, node):
+        im.env[node.outputs[0]] = build(im.materialize(node.inputs[0]))
+    return fn
+
+
+_IMPORTERS["Add"] = _binop(ops.add_op)
+_IMPORTERS["Mul"] = _binop(ops.mul_op)
+_IMPORTERS["Div"] = _binop(ops.div_op)
+_IMPORTERS["MatMul"] = _binop(ops.matmul_op)
+_IMPORTERS["Neg"] = _unop(ops.opposite_op)
+_IMPORTERS["Sqrt"] = _unop(ops.sqrt_op)
+_IMPORTERS["Relu"] = _unop(ops.relu_op)
+_IMPORTERS["Sigmoid"] = _unop(ops.sigmoid_op)
+_IMPORTERS["Tanh"] = _unop(ops.tanh_op)
+_IMPORTERS["Exp"] = _unop(ops.exp_op)
+_IMPORTERS["Log"] = _unop(ops.log_op)
+_IMPORTERS["Abs"] = _unop(ops.abs_op)
+_IMPORTERS["Identity"] = _unop(lambda x: x)
+
+
+@imports("Erf")
+def _erf(im, node):
+    from ..ops.basic import erf_op
+    im.env[node.outputs[0]] = erf_op(im.materialize(node.inputs[0]))
+
+
+@imports("Softmax")
+def _softmax(im, node):
+    im.env[node.outputs[0]] = ops.softmax_op(
+        im.materialize(node.inputs[0]))
+
+
+@imports("Dropout")
+def _dropout(im, node):
+    ratio = node.attr("ratio", 0.5)
+    im.env[node.outputs[0]] = ops.dropout_op(
+        im.materialize(node.inputs[0]), 1.0 - float(ratio))
+
+
+@imports("Reshape")
+def _reshape(im, node):
+    shape = [int(s) for s in im.const(node.inputs[1])]
+    im.env[node.outputs[0]] = ops.array_reshape_op(
+        im.materialize(node.inputs[0]), shape)
+
+
+@imports("Transpose")
+def _transpose(im, node):
+    im.env[node.outputs[0]] = ops.transpose_op(
+        im.materialize(node.inputs[0]), _attr_ints(node, "perm") or None)
+
+
+@imports("Concat")
+def _concat(im, node):
+    axis = int(node.attr("axis", 0))
+    nodes = [im.materialize(i) for i in node.inputs]
+    out = nodes[0]
+    for nxt in nodes[1:]:
+        out = ops.concat_op(out, nxt, axis=axis)
+    im.env[node.outputs[0]] = out
+
+
+@imports("Slice")
+def _slice(im, node):
+    starts = [int(s) for s in im.const(node.inputs[1])]
+    ends = [int(e) for e in im.const(node.inputs[2])]
+    sizes = [e - s for s, e in zip(starts, ends)]
+    im.env[node.outputs[0]] = ops.slice_op(
+        im.materialize(node.inputs[0]), starts, sizes)
+
+
+@imports("Pad")
+def _pad(im, node):
+    pads = [int(p) for p in im.const(node.inputs[1])]
+    n = len(pads) // 2
+    paddings = [(pads[i], pads[i + n]) for i in range(n)]
+    cval = 0.0
+    if len(node.inputs) > 2:
+        cval = float(im.const(node.inputs[2]))
+    mode = node.attr("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    im.env[node.outputs[0]] = ops.pad_op(
+        im.materialize(node.inputs[0]), paddings, mode=mode.upper(),
+        constant_values=cval)
+
+
+@imports("ReduceSum", "ReduceMean")
+def _reduce(im, node):
+    build = ops.reduce_sum_op if node.op_type == "ReduceSum" \
+        else ops.reduce_mean_op
+    axes = _attr_ints(node, "axes")
+    if not axes and len(node.inputs) > 1:     # opset 13 form
+        axes = [int(a) for a in im.const(node.inputs[1])]
+    keep = bool(node.attr("keepdims", 1))
+    im.env[node.outputs[0]] = build(
+        im.materialize(node.inputs[0]), axes, keepdims=keep)
+
+
+@imports("Expand")
+def _expand(im, node):
+    shape = [int(s) for s in im.const(node.inputs[1])]
+    im.env[node.outputs[0]] = ops.broadcast_shape_op(
+        im.materialize(node.inputs[0]), shape)
+
+
+@imports("Conv")
+def _conv(im, node):
+    pads = _attr_ints(node, "pads", [0, 0, 0, 0])
+    strides = _attr_ints(node, "strides", [1, 1])
+    im.env[node.outputs[0]] = ops.conv2d_op(
+        im.materialize(node.inputs[0]), im.materialize(node.inputs[1]),
+        padding=pads[0], stride=strides[0])
+
+
+@imports("MaxPool", "AveragePool")
+def _pool(im, node):
+    build = ops.max_pool2d_op if node.op_type == "MaxPool" \
+        else ops.avg_pool2d_op
+    ks = _attr_ints(node, "kernel_shape", [1, 1])
+    pads = _attr_ints(node, "pads", [0, 0, 0, 0])
+    strides = _attr_ints(node, "strides", [1, 1])
+    im.env[node.outputs[0]] = build(
+        im.materialize(node.inputs[0]), ks[0], ks[1],
+        padding=pads[0], stride=strides[0])
+
+
+@imports("BatchNormalization")
+def _batchnorm(im, node):
+    # imported as inference-form normalization seeded with the stored
+    # running stats (they land in executor state at first run)
+    out = ops.batch_normalization_op(
+        im.materialize(node.inputs[0]), im.materialize(node.inputs[1]),
+        im.materialize(node.inputs[2]),
+        eps=float(node.attr("epsilon", 1e-5)),
+        momentum=float(node.attr("momentum", 0.99)))
+    out.imported_stats = {
+        "running_mean": im.const(node.inputs[3]),
+        "running_var": im.const(node.inputs[4]),
+    }
+    im.env[node.outputs[0]] = out
+
+
+@imports("Gather")
+def _gather(im, node):
+    im.env[node.outputs[0]] = ops.embedding_lookup_op(
+        im.materialize(node.inputs[0]), im.materialize(node.inputs[1]))
+
+
+@imports("OneHot")
+def _onehot(im, node):
+    depth = int(np.asarray(im.const(node.inputs[1])).ravel()[0])
+    im.env[node.outputs[0]] = ops.one_hot_op(
+        im.materialize(node.inputs[0]), depth)
+
+
+def load_onnx(path):
+    """(outputs, feed_placeholders) rebuilt from an ONNX file."""
+    return _Importer(Model.load(path)).run()

@@ -1,0 +1,402 @@
+"""Minimal ONNX protobuf wire codec (no ``onnx`` package dependency).
+
+Reference parity: python/hetu/onnx/* serializes through the onnx pip
+package; this environment has none, so the subset of the ONNX schema the
+converters emit — ModelProto / GraphProto / NodeProto / TensorProto /
+AttributeProto / ValueInfoProto — is encoded and decoded directly on the
+protobuf wire format (varint + length-delimited fields). Files written
+here load in stock onnx/onnxruntime, and models exported by standard
+tools round-trip back in, as long as they stay within the supported ops.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ["Model", "Graph", "Node", "Tensor", "Attribute", "ValueInfo",
+           "TENSOR_FLOAT", "TENSOR_INT64", "TENSOR_INT32", "NP_TO_ONNX",
+           "ONNX_TO_NP"]
+
+TENSOR_FLOAT = 1
+TENSOR_INT32 = 6
+TENSOR_INT64 = 7
+
+NP_TO_ONNX = {np.dtype(np.float32): TENSOR_FLOAT,
+              np.dtype(np.int32): TENSOR_INT32,
+              np.dtype(np.int64): TENSOR_INT64}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# -- wire primitives --------------------------------------------------------
+
+def _varint(n):
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _vint(field, value):
+    return _key(field, 0) + _varint(int(value))
+
+
+def _f32(field, value):
+    return _key(field, 5) + struct.pack("<f", float(value))
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf):
+    """Yield (field_num, wire_type, value) over one message body."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+# -- messages ---------------------------------------------------------------
+
+class Tensor:
+    """TensorProto (raw_data encoding)."""
+
+    def __init__(self, name="", array=None):
+        self.name = name
+        self.array = None
+        if array is not None:
+            self.array = np.ascontiguousarray(array)
+
+    def serialize(self):
+        a = self.array
+        dt = NP_TO_ONNX[a.dtype]
+        out = b"".join(_vint(1, d) for d in a.shape)
+        out += _vint(2, dt)
+        out += _ld(8, self.name.encode())
+        out += _ld(9, a.tobytes())
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        t = cls()
+        dims, dtype, raw = [], TENSOR_FLOAT, b""
+        float_data, int64_data, int32_data = [], [], []
+        for field, wire, value in _fields(buf):
+            if field == 1:
+                dims.append(_signed(value))
+            elif field == 2:
+                dtype = value
+            elif field == 8:
+                t.name = value.decode()
+            elif field == 9:
+                raw = value
+            elif field == 4:      # packed float_data
+                float_data.extend(
+                    struct.unpack(f"<{len(value) // 4}f", value)
+                    if wire == 2 else
+                    struct.unpack("<f", value))
+            elif field == 7:      # int64_data
+                if wire == 2:
+                    pos = 0
+                    while pos < len(value):
+                        v, pos = _read_varint(value, pos)
+                        int64_data.append(_signed(v))
+                else:
+                    int64_data.append(_signed(value))
+            elif field == 5:      # int32_data
+                if wire == 2:
+                    pos = 0
+                    while pos < len(value):
+                        v, pos = _read_varint(value, pos)
+                        int32_data.append(v)
+                else:
+                    int32_data.append(value)
+        np_dt = ONNX_TO_NP.get(dtype, np.dtype(np.float32))
+        if raw:
+            t.array = np.frombuffer(raw, np_dt).reshape(dims).copy()
+        elif float_data:
+            t.array = np.asarray(float_data, np.float32).reshape(dims)
+        elif int64_data:
+            t.array = np.asarray(int64_data, np.int64).reshape(dims)
+        elif int32_data:
+            t.array = np.asarray(int32_data, np.int32).reshape(dims)
+        else:
+            t.array = np.zeros(dims, np_dt)
+        return t
+
+
+class Attribute:
+    def __init__(self, name="", value=None, kind=None):
+        self.name = name
+        self.value = value
+        self.kind = kind
+        if kind is None and value is not None:
+            if isinstance(value, float):
+                self.kind = A_FLOAT
+            elif isinstance(value, (bool, int, np.integer)):
+                self.kind = A_INT
+            elif isinstance(value, (str, bytes)):
+                self.kind = A_STRING
+            elif isinstance(value, Tensor):
+                self.kind = A_TENSOR
+            elif isinstance(value, (list, tuple)) and value and \
+                    isinstance(value[0], float):
+                self.kind = A_FLOATS
+            else:
+                self.kind = A_INTS
+
+    def serialize(self):
+        out = _ld(1, self.name.encode())
+        if self.kind == A_FLOAT:
+            out += _f32(2, self.value)
+        elif self.kind == A_INT:
+            out += _vint(3, self.value)
+        elif self.kind == A_STRING:
+            v = self.value.encode() if isinstance(self.value, str) \
+                else self.value
+            out += _ld(4, v)
+        elif self.kind == A_TENSOR:
+            out += _ld(5, self.value.serialize())
+        elif self.kind == A_FLOATS:
+            for v in self.value:
+                out += _f32(7, v)
+        elif self.kind == A_INTS:
+            for v in self.value:
+                out += _vint(8, v)
+        else:
+            raise ValueError(f"attribute kind {self.kind}")
+        out += _vint(20, self.kind)
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        a = cls()
+        floats, ints = [], []
+        for field, wire, value in _fields(buf):
+            if field == 1:
+                a.name = value.decode()
+            elif field == 2:
+                a.value = struct.unpack("<f", value)[0]
+                a.kind = A_FLOAT
+            elif field == 3:
+                ints.append(_signed(value))
+            elif field == 4:
+                a.value = value
+                a.kind = A_STRING
+            elif field == 5:
+                a.value = Tensor.parse(value)
+                a.kind = A_TENSOR
+            elif field == 7:
+                floats.append(struct.unpack("<f", value)[0])
+            elif field == 8:
+                if wire == 2:     # packed
+                    pos = 0
+                    while pos < len(value):
+                        v, pos = _read_varint(value, pos)
+                        ints.append(_signed(v))
+                else:
+                    ints.append(_signed(value))
+            elif field == 20:
+                a.kind = value
+        if a.kind == A_INT:
+            a.value = ints[0] if ints else 0
+        elif a.kind == A_INTS:
+            a.value = ints
+        elif a.kind == A_FLOATS:
+            a.value = floats
+        return a
+
+
+class Node:
+    def __init__(self, op_type="", inputs=(), outputs=(), name="",
+                 attrs=None):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def attr(self, name, default=None):
+        a = self.attrs.get(name)
+        return default if a is None else a.value
+
+    def serialize(self):
+        out = b"".join(_ld(1, i.encode()) for i in self.inputs)
+        out += b"".join(_ld(2, o.encode()) for o in self.outputs)
+        out += _ld(3, self.name.encode())
+        out += _ld(4, self.op_type.encode())
+        out += b"".join(_ld(5, a.serialize())
+                        for a in self.attrs.values())
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        n = cls()
+        for field, wire, value in _fields(buf):
+            if field == 1:
+                n.inputs.append(value.decode())
+            elif field == 2:
+                n.outputs.append(value.decode())
+            elif field == 3:
+                n.name = value.decode()
+            elif field == 4:
+                n.op_type = value.decode()
+            elif field == 5:
+                a = Attribute.parse(value)
+                n.attrs[a.name] = a
+        return n
+
+
+class ValueInfo:
+    def __init__(self, name="", dtype=TENSOR_FLOAT, shape=()):
+        self.name = name
+        self.dtype = dtype
+        self.shape = tuple(shape)
+
+    def serialize(self):
+        dims = b"".join(_ld(1, _vint(1, d)) for d in self.shape)
+        tensor_type = _vint(1, self.dtype) + _ld(2, dims)
+        return _ld(1, self.name.encode()) + _ld(2, _ld(1, tensor_type))
+
+    @classmethod
+    def parse(cls, buf):
+        vi = cls()
+        for field, _w, value in _fields(buf):
+            if field == 1:
+                vi.name = value.decode()
+            elif field == 2:
+                for f2, _w2, v2 in _fields(value):
+                    if f2 != 1:
+                        continue
+                    shape = []
+                    for f3, _w3, v3 in _fields(v2):
+                        if f3 == 1:
+                            vi.dtype = v3
+                        elif f3 == 2:
+                            for f4, _w4, v4 in _fields(v3):
+                                if f4 == 1:    # Dimension
+                                    for f5, _w5, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            shape.append(_signed(v5))
+                                        elif f5 == 2:
+                                            shape.append(None)  # dim_param
+                    vi.shape = tuple(shape)
+        return vi
+
+
+class Graph:
+    def __init__(self, name="hetu"):
+        self.name = name
+        self.nodes = []
+        self.initializers = []
+        self.inputs = []
+        self.outputs = []
+
+    def serialize(self):
+        out = b"".join(_ld(1, n.serialize()) for n in self.nodes)
+        out += _ld(2, self.name.encode())
+        out += b"".join(_ld(5, t.serialize()) for t in self.initializers)
+        out += b"".join(_ld(11, vi.serialize()) for vi in self.inputs)
+        out += b"".join(_ld(12, vi.serialize()) for vi in self.outputs)
+        return out
+
+    @classmethod
+    def parse(cls, buf):
+        g = cls()
+        for field, _w, value in _fields(buf):
+            if field == 1:
+                g.nodes.append(Node.parse(value))
+            elif field == 2:
+                g.name = value.decode()
+            elif field == 5:
+                g.initializers.append(Tensor.parse(value))
+            elif field == 11:
+                g.inputs.append(ValueInfo.parse(value))
+            elif field == 12:
+                g.outputs.append(ValueInfo.parse(value))
+        return g
+
+
+class Model:
+    def __init__(self, graph=None, opset=9, producer="hetu-tpu"):
+        self.graph = graph or Graph()
+        self.opset = opset
+        self.producer = producer
+        self.ir_version = 6
+
+    def serialize(self):
+        opset = _ld(1, b"") + _vint(2, self.opset)
+        return (_vint(1, self.ir_version)
+                + _ld(2, self.producer.encode())
+                + _ld(7, self.graph.serialize())
+                + _ld(8, opset))
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            f.write(self.serialize())
+
+    @classmethod
+    def parse(cls, buf):
+        m = cls()
+        for field, _w, value in _fields(buf):
+            if field == 1:
+                m.ir_version = value
+            elif field == 2:
+                m.producer = value.decode()
+            elif field == 7:
+                m.graph = Graph.parse(value)
+            elif field == 8:
+                for f2, _w2, v2 in _fields(value):
+                    if f2 == 2:
+                        m.opset = v2
+        return m
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls.parse(f.read())

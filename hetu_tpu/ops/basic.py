@@ -8,6 +8,7 @@ exactly the fusion the reference's hand-written elementwise CUDA kernels
 """
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..graph.node import Op
@@ -16,7 +17,7 @@ __all__ = [
     "add_op", "addbyconst_op", "mul_op", "mul_byconst_op", "div_op",
     "div_const_op", "div_handle_zero_op", "opposite_op", "sqrt_op",
     "rsqrt_op", "where_op", "one_hot_op", "matrix_dot_op", "power_op",
-    "exp_op", "log_op", "abs_op",
+    "exp_op", "log_op", "abs_op", "erf_op",
 ]
 
 
@@ -211,6 +212,28 @@ class SqrtOp(Op):
         return input_shapes[0]
 
 
+class ErfOp(Op):
+    """Gauss error function (ONNX Erf parity; gelu's erf form imports
+    through this)."""
+
+    def __init__(self, node_A, ctx=None):
+        super().__init__(ErfOp, [node_A], ctx)
+
+    def compute(self, input_vals, ectx):
+        import jax
+        return jax.lax.erf(input_vals[0])
+
+    def gradient(self, output_grad):
+        # d erf(x) = 2/sqrt(pi) * exp(-x^2)
+        x = self.inputs[0]
+        g = mul_byconst_op(exp_op(opposite_op(mul_op(x, x))),
+                           2.0 / np.sqrt(np.pi))
+        return [mul_op(output_grad, g, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
 class ReciprocalSqrtOp(Op):
     def __init__(self, node_A, ctx=None):
         super().__init__(ReciprocalSqrtOp, [node_A], ctx)
@@ -384,6 +407,10 @@ def opposite_op(node_A, ctx=None):
 
 def sqrt_op(node, ctx=None):
     return SqrtOp(node, ctx=ctx)
+
+
+def erf_op(node, ctx=None):
+    return ErfOp(node, ctx=ctx)
 
 
 def rsqrt_op(node, ctx=None):
