@@ -345,22 +345,29 @@ class SubExecutor:
 
     def _shape_key(self, feed_map):
         key = []
+        from .parallel.distgcn import DistCSR15d
         for node in self._feed_order():
             v = feed_map[node]
             if isinstance(v, ndarray.CSRValue):
                 key.append(("csr", v.data.shape, v.nrow, v.ncol))
+            elif isinstance(v, DistCSR15d):
+                key.append(("distcsr", v.data.shape, v.n_nodes))
             else:
                 key.append((tuple(v.shape), str(v.dtype)))
         return tuple(key)
 
     def _infer_shapes(self, feed_map):
         shapes = {}
+        from .parallel.distgcn import DistCSR15d
         for node in self.topo_order:
             if node in feed_map:
                 v = feed_map[node]
-                shape = ((v.nrow, v.ncol)
-                         if isinstance(v, ndarray.CSRValue)
-                         else tuple(v.shape))
+                if isinstance(v, ndarray.CSRValue):
+                    shape = (v.nrow, v.ncol)
+                elif isinstance(v, DistCSR15d):
+                    shape = (v.n_nodes, v.n_nodes)
+                else:
+                    shape = tuple(v.shape)
             elif isinstance(node, PlaceholderOp):
                 shape = tuple(node.shape)
             else:
@@ -648,9 +655,10 @@ class SubExecutor:
 
     def _ingest(self, value):
         """Host value -> device value (with DP batch sharding)."""
+        from .parallel.distgcn import DistCSR15d
         if isinstance(value, ndarray.ND_Sparse_Array):
             return ndarray.CSRValue.from_sparse_array(value)
-        if isinstance(value, ndarray.CSRValue):
+        if isinstance(value, (ndarray.CSRValue, DistCSR15d)):
             return value
         if isinstance(value, ndarray.NDArray):
             value = value.jax_array

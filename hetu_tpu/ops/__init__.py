@@ -41,7 +41,7 @@ from .norm import (
     instance_normalization2d_op, instance_normalization2d_gradient_op,
 )
 from .embedding import embedding_lookup_op, embedding_lookup_gradient_op
-from .sparse import csrmv_op, csrmm_op
+from .sparse import csrmv_op, csrmm_op, distgcn_15d_op
 from .attention import flash_attention_op, ring_attention_op
 from .comm import (
     allreduceCommunicate_op, groupallreduceCommunicate_op,

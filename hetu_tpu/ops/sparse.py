@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from ..graph.node import Op
 
-__all__ = ["csrmv_op", "csrmm_op"]
+__all__ = ["csrmv_op", "csrmm_op", "distgcn_15d_op"]
 
 
 def _row_ids(sp):
@@ -107,6 +107,77 @@ class CsrmvOp(Op):
     def infer_shape(self, input_shapes):
         a = input_shapes[0]
         return (a[1],) if self.trans else (a[0],)
+
+
+class DistGCN15dOp(Op):
+    """Distributed GCN layer z = A @ (H @ W) over a ("gr", "gc") mesh
+    (reference gpu_ops/DistGCN_15d.py DistGCN_15dOp). ``node_A`` feeds a
+    :class:`~hetu_tpu.parallel.distgcn.DistCSR15d` partition; W applies
+    on whichever side keeps the SpMM feature dim smaller, exactly like
+    the reference's dim check (DistGCN_15d.py:96-117)."""
+
+    def __init__(self, node_A, node_H, node_W, need_W=True, ctx=None):
+        super().__init__(DistGCN15dOp, [node_A, node_H, node_W], ctx)
+        self.need_W = need_W
+
+    def _forward(self, adj, h, w, mesh):
+        from ..parallel.distgcn import dist_gcn_spmm
+        if self.need_W and w.shape[1] < h.shape[1]:
+            return dist_gcn_spmm(adj, h @ w, mesh)
+        z = dist_gcn_spmm(adj, h, mesh)
+        return z @ w if self.need_W else z
+
+    def _mesh(self, ectx):
+        mesh = getattr(getattr(ectx, "config", None), "mesh", None)
+        assert mesh is not None and "gr" in mesh.axis_names \
+            and "gc" in mesh.axis_names, \
+            "distgcn_15d_op needs a mesh with ('gr', 'gc') axes"
+        return mesh
+
+    def compute(self, input_vals, ectx):
+        adj, h, w = input_vals
+        return self._forward(adj, h, w, self._mesh(ectx))
+
+    def gradient(self, output_grad):
+        grads = [_DistGCN15dGradOp(self, output_grad, i,
+                                   ctx=self.raw_ctx) for i in range(2)]
+        return [None, grads[0], grads[1]]
+
+    def infer_shape(self, input_shapes):
+        _, h, w = input_shapes
+        return (h[0], w[1]) if self.need_W else tuple(h)
+
+
+class _DistGCN15dGradOp(Op):
+    """dH / dW through the ring (ppermute transposes to the reverse
+    rotation, psum to identity under shard_map autodiff)."""
+
+    def __init__(self, forward_op, output_grad, which, ctx=None):
+        super().__init__(_DistGCN15dGradOp,
+                         list(forward_op.inputs) + [output_grad], ctx)
+        self.forward_op = forward_op
+        self.which = which
+
+    def compute(self, input_vals, ectx):
+        fwd = self.forward_op
+        adj, h, w, dy = input_vals
+        cache_key = ("distgcn_vjp", fwd.id)
+        if cache_key not in ectx.cache:
+            mesh = fwd._mesh(ectx)
+            _, vjp = jax.vjp(
+                lambda h_, w_: fwd._forward(adj, h_, w_, mesh), h, w)
+            ectx.cache[cache_key] = vjp(dy)
+        return ectx.cache[cache_key][self.which]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[1 + self.which]
+
+
+def distgcn_15d_op(node_A, node_H, node_W, need_W=True, ctx=None):
+    return DistGCN15dOp(node_A, node_H, node_W, need_W=need_W, ctx=ctx)
 
 
 def csrmv_op(node_A, node_B, trans=False, ctx=None):
