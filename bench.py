@@ -337,6 +337,37 @@ def bench_bert():
          tps / BERT_BASELINE_TPS)
 
 
+def bench_pp():
+    """Pipeline-parallel step-time microbench: 2-stage GPipe MLP, 4
+    microbatches (stages share the one real chip here; the number tracks
+    schedule + dispatch overhead, which is what the async cleanup
+    targets — no host syncs inside the microbatch loops)."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+
+    rng = np.random.RandomState(0)
+    with ht.context(ht.cpu(0)):
+        x = ht.Variable("x", trainable=False)
+        w1 = ht.Variable("w1", value=rng.randn(256, 512).astype("f") * .05)
+        a = ht.relu_op(ht.matmul_op(x, w1))
+    with ht.context(ht.cpu(0)):
+        w2 = ht.Variable("w2", value=rng.randn(512, 64).astype("f") * .05)
+        logits = ht.matmul_op(a, w2)
+        y_ = ht.Variable("y_", trainable=False)
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_),
+                                 [0])
+        train_op = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
+    feeds = {x: rng.randn(64, 256).astype("f"),
+             y_: np.eye(64, dtype="f")[rng.randint(0, 64, 64)]}
+    for _ in range(3):
+        exe.run(feed_dict=feeds)
+    steps = 30
+    dt = _time_steps(lambda: exe.run(feed_dict=feeds), steps)
+    ms = dt / steps * 1000
+    emit("pp_gpipe_2stage_step_time", ms, "ms/step", 1.0)
+
+
 def bench_bert_long_seq():
     """Long-context single chip: BERT-small at S=2048 through the Pallas
     flash path (the memory profile ring attention extends across chips —
@@ -389,8 +420,8 @@ def main():
     import jax
 
     for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
-               bench_wdl_hybrid, bench_gcn, bench_bert_long_seq,
-               bench_bert):
+               bench_wdl_hybrid, bench_gcn, bench_pp,
+               bench_bert_long_seq, bench_bert):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

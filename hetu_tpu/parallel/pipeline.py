@@ -301,7 +301,8 @@ class PipelineSubExecutor:
         else:
             losses = self._run_1f1b(executor, feeds, M)
         self.step_count += 1
-        loss = float(np.mean(losses))
+        # mean on device — the only sync is the caller's (asnumpy/convert)
+        loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
         results = []
         for ev in self.eval_nodes:
             results.append(loss if ev is self.loss_node else None)
@@ -312,10 +313,9 @@ class PipelineSubExecutor:
             if r is None:
                 out.append(None)
             elif convert_to_numpy_ret_vals:
-                out.append(np.float32(r))
+                out.append(np.asarray(r))
             else:
-                out.append(ndarray.array(np.asarray(r, np.float32),
-                                         ctx=None))
+                out.append(ndarray.NDArray(r, None))
         return out
 
     # -- forward/backward of one microbatch through one stage ------------
@@ -377,7 +377,7 @@ class PipelineSubExecutor:
                         jnp.add, grads[stage.index], dparams)
 
         self._apply(executor, grads)
-        return [float(np.asarray(l)) for l in losses]
+        return losses           # device values: no host sync per loss
 
     def _run_1f1b(self, executor, feeds, M):
         """1F1B: warmup forwards then alternate, per-microbatch updates
@@ -435,25 +435,46 @@ class PipelineSubExecutor:
         while done_b < M:
             backward(done_b)
             done_b += 1
-        return [float(np.asarray(l)) for l in losses]
+        return losses           # device values: no host sync per loss
 
     # ------------------------------------------------------------------
     def _apply(self, executor, grads):
-        """Per-stage functional optimizer update on the stage device."""
+        """Per-stage optimizer update as ONE jitted dispatch per stage
+        (host-driven per-param eager ops would serialize the 1F1B
+        schedule against dispatch latency)."""
         opt = self.optimizer
-        lr = opt.learning_rate
+        lr = np.float32(opt.learning_rate)
+        if not hasattr(self, "_apply_jits"):
+            self._apply_jits = {}
         for stage, dp in zip(self.stages, grads):
             if dp is None or not stage.param_nodes:
                 continue
-            param_vals = {n: stage.params[str(n.id)]
+            fn = self._apply_jits.get(stage.index)
+            if fn is None:
+                nodes = {str(n.id): n for n in stage.param_nodes}
+
+                def apply_fn(params_sid, grads_sid, opt_state, lr_, step,
+                             _nodes=nodes):
+                    pv = {_nodes[sid]: v for sid, v in params_sid.items()}
+                    gv = {_nodes[sid]: v for sid, v in grads_sid.items()}
+                    new_p, new_s = opt.update(pv, gv, opt_state, lr_,
+                                              step)
+                    return ({str(n.id): v for n, v in new_p.items()},
+                            new_s)
+
+                # no donation: 1F1B weight stashes may still reference
+                # the pre-update buffers of in-flight microbatches
+                fn = self._apply_jits[stage.index] = jax.jit(apply_fn)
+            param_vals = {str(n.id): stage.params[str(n.id)]
                           for n in stage.param_nodes}
-            grad_vals = {n: dp[str(n.id)] for n in stage.param_nodes}
-            new_params, new_state = opt.update(
+            grad_vals = {str(n.id): dp[str(n.id)]
+                         for n in stage.param_nodes}
+            new_params, new_state = fn(
                 param_vals, grad_vals, executor.opt_state or {}, lr,
-                self.step_count)
-            for n, v in new_params.items():
-                stage.params[str(n.id)] = v
-                executor.params[str(n.id)] = v
+                np.int32(self.step_count))
+            for sid, v in new_params.items():
+                stage.params[sid] = v
+                executor.params[sid] = v
             executor.opt_state = {**(executor.opt_state or {}),
                                   **new_state}
         opt.lr_sched.step()
