@@ -1,0 +1,204 @@
+"""Graphboard: render a session's graph to DOT + standalone HTML/SVG.
+
+Reference parity: python/graphboard/graph2fig.py renders the topo order
+through graphviz and serves a PNG over SimpleHTTPServer. This
+environment ships neither graphviz nor a browser plugin, so the
+renderer here computes a layered DAG layout itself (longest-path
+layering + barycenter ordering) and writes a self-contained SVG inside
+an HTML page — plus the .dot source for anyone with graphviz installed.
+Nodes are annotated with the executor's parallel placement: pipeline
+stage (color) and TP PartitionSpec / NodeStatus when the planner
+assigned one.
+"""
+from __future__ import annotations
+
+import html
+import os
+
+__all__ = ["show", "render", "close"]
+
+_server = None
+
+_STAGE_COLORS = ["#cfe2f3", "#d9ead3", "#fff2cc", "#f4cccc", "#d9d2e9",
+                 "#fce5cd", "#d0e0e3", "#ead1dc"]
+
+
+def _topo(executor):
+    for sub in getattr(executor, "subexecutors", {}).values():
+        if hasattr(sub, "topo_order"):
+            return sub.topo_order
+        if hasattr(sub, "stages"):      # pipeline: concat stage node lists
+            out = []
+            for st in sub.stages:
+                for n in getattr(st, "nodes", []):
+                    if n not in out:
+                        out.append(n)
+            if out:
+                return out
+    raise ValueError("executor has no topo order to render")
+
+
+def _annotations(executor, topo):
+    """node -> (stage_index or None, spec string or None)."""
+    config = getattr(executor, "config", None)
+    spec_map = getattr(config, "node_spec", {}) if config else {}
+    status_map = getattr(config, "node_status", {}) if config else {}
+    stage_of = {}
+    for sub in getattr(executor, "subexecutors", {}).values():
+        assign = getattr(sub, "assign", None)
+        if assign:
+            stage_of.update(assign)
+    out = {}
+    for node in topo:
+        spec = spec_map.get(node)
+        if spec is None:
+            st = status_map.get(node)
+            spec = getattr(st, "state", None) if st is not None else None
+        out[node] = (stage_of.get(node), None if spec is None
+                     else str(tuple(spec)))
+    return out
+
+
+def to_dot(executor):
+    """Graphviz source for the session graph (reference
+    graph2fig.py:11-23 builds the same node/edge list)."""
+    topo = _topo(executor)
+    ann = _annotations(executor, topo)
+    lines = ["digraph hetu {", "  rankdir=TB;",
+             '  node [shape=box, fontsize=10];']
+    for node in topo:
+        stage, spec = ann[node]
+        label = node.name
+        if stage is not None:
+            label += f"\\nstage {stage}"
+        if spec:
+            label += f"\\n{spec}"
+        color = _STAGE_COLORS[stage % len(_STAGE_COLORS)] \
+            if stage is not None else "#eeeeee"
+        lines.append(f'  n{node.id} [label="{label}", style=filled, '
+                     f'fillcolor="{color}"];')
+    for node in topo:
+        for inp in node.inputs:
+            lines.append(f"  n{inp.id} -> n{node.id};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _layout(topo):
+    """Longest-path layering + two barycenter sweeps; returns
+    node -> (x, y) grid coords and the layer list."""
+    depth = {}
+    for node in topo:
+        depth[node] = 1 + max((depth[i] for i in node.inputs
+                               if i in depth), default=-1)
+    layers = {}
+    for node, d in depth.items():
+        layers.setdefault(d, []).append(node)
+    order = {d: list(ns) for d, ns in layers.items()}
+    pos = {}
+    for d in sorted(order):
+        for i, n in enumerate(order[d]):
+            pos[n] = i
+    for _ in range(2):
+        for d in sorted(order)[1:]:
+            def bary(n):
+                ins = [pos[i] for i in n.inputs if i in pos]
+                return sum(ins) / len(ins) if ins else pos[n]
+            order[d].sort(key=bary)
+            for i, n in enumerate(order[d]):
+                pos[n] = i
+    coords = {}
+    for d in sorted(order):
+        for i, n in enumerate(order[d]):
+            coords[n] = (i, d)
+    return coords, order
+
+
+def render(executor, path="graphboard.html"):
+    """Write a standalone HTML/SVG of the graph (plus .dot beside it);
+    returns the html path."""
+    topo = _topo(executor)
+    ann = _annotations(executor, topo)
+    coords, order = _layout(topo)
+
+    bw, bh, gx, gy = 148, 44, 24, 50
+    width = (max(len(ns) for ns in order.values())) * (bw + gx) + gx
+    height = (max(order) + 1) * (bh + gy) + gy
+
+    def center(n):
+        x, y = coords[n]
+        return (gx + x * (bw + gx) + bw / 2, gy + y * (bh + gy) + bh / 2)
+
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+             f'height="{height}" font-family="monospace" font-size="10">',
+             '<defs><marker id="a" viewBox="0 0 10 10" refX="9" refY="5" '
+             'markerWidth="6" markerHeight="6" orient="auto-start-reverse">'
+             '<path d="M 0 0 L 10 5 L 0 10 z" fill="#555"/></marker>'
+             '</defs>']
+    for node in topo:
+        for inp in node.inputs:
+            if inp not in coords:
+                continue
+            x1, y1 = center(inp)
+            x2, y2 = center(node)
+            parts.append(
+                f'<line x1="{x1:.0f}" y1="{y1 + bh / 2:.0f}" '
+                f'x2="{x2:.0f}" y2="{y2 - bh / 2:.0f}" stroke="#555" '
+                'stroke-width="1" marker-end="url(#a)"/>')
+    for node in topo:
+        x, y = coords[node]
+        px, py = gx + x * (bw + gx), gy + y * (bh + gy)
+        stage, spec = ann[node]
+        fill = _STAGE_COLORS[stage % len(_STAGE_COLORS)] \
+            if stage is not None else "#f5f5f5"
+        title = html.escape(getattr(node, "desc", node.name))
+        sub = " / ".join(x for x in (
+            f"stage {stage}" if stage is not None else None,
+            spec) if x)
+        parts.append(
+            f'<g><title>{title}</title>'
+            f'<rect x="{px}" y="{py}" width="{bw}" height="{bh}" '
+            f'rx="5" fill="{fill}" stroke="#888"/>'
+            f'<text x="{px + bw / 2:.0f}" y="{py + 18}" '
+            f'text-anchor="middle">{html.escape(node.name[:22])}</text>'
+            + (f'<text x="{px + bw / 2:.0f}" y="{py + 34}" '
+               f'text-anchor="middle" fill="#666" font-size="8">'
+               f'{html.escape(sub[:26])}</text>' if sub else "")
+            + "</g>")
+    parts.append("</svg>")
+    svg = "\n".join(parts)
+
+    page = ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>hetu graphboard</title></head><body>"
+            f"<h3>hetu graph — {len(topo)} nodes</h3>{svg}</body></html>")
+    with open(path, "w") as f:
+        f.write(page)
+    with open(os.path.splitext(path)[0] + ".dot", "w") as f:
+        f.write(to_dot(executor))
+    return path
+
+
+def show(executor, path="graphboard.html", port=None):
+    """Render and (optionally) serve like the reference's graphboard
+    (graph2fig.py:11-33). ``port=None`` skips the server."""
+    out = render(executor, path)
+    if port is None:
+        return out
+    import functools
+    import http.server
+    import threading
+    global _server
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler,
+        directory=os.path.dirname(os.path.abspath(out)) or ".")
+    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                              handler)
+    threading.Thread(target=_server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{port}/{os.path.basename(out)}"
+
+
+def close():
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -1,0 +1,64 @@
+"""Graphboard (reference python/graphboard/graph2fig.py analogue)."""
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import graphboard
+from hetu_tpu.executor import Executor
+
+
+def _mlp():
+    x = ht.Variable("gb_x", trainable=False)
+    y_ = ht.Variable("gb_y", trainable=False)
+    w1 = ht.init.xavier_normal((12, 8), name="gb_w1")
+    w2 = ht.init.xavier_normal((8, 4), name="gb_w2")
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return x, y_, loss, train
+
+
+def test_render_html_and_dot(tmp_path):
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train])
+    out = graphboard.render(exe, str(tmp_path / "g.html"))
+    page = open(out).read()
+    dot = open(str(tmp_path / "g.dot")).read()
+    # every topo node appears in both artifacts
+    topo = exe.subexecutors["default"].topo_order
+    assert f"{len(topo)} nodes" in page
+    for node in topo:
+        assert f"n{node.id}" in dot
+    assert "<svg" in page and "MatMulOp" in page
+    assert dot.count("->") >= len(topo) - 1
+
+
+def test_show_serves(tmp_path):
+    import urllib.request
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train])
+    url = graphboard.show(exe, str(tmp_path / "g.html"), port=18731)
+    try:
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert "<svg" in body
+    finally:
+        graphboard.close()
+
+
+def test_pipeline_stage_annotations(tmp_path):
+    with ht.context(ht.cpu(0)):
+        x = ht.Variable("pb_x", trainable=False)
+        w1 = ht.Variable("pb_w1",
+                         value=np.random.randn(8, 6).astype("f"))
+        a = ht.relu_op(ht.matmul_op(x, w1))
+    with ht.context(ht.cpu(1)):
+        w2 = ht.Variable("pb_w2",
+                         value=np.random.randn(6, 3).astype("f"))
+        y_ = ht.Variable("pb_y", trainable=False)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(a, w2), y_), [0])
+        train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    exe = Executor([loss, train], gpipe=True, num_microbatches=2)
+    out = graphboard.render(exe, str(tmp_path / "p.html"))
+    page = open(out).read()
+    assert "stage 0" in page and "stage 1" in page
