@@ -103,8 +103,10 @@ class ClusterConfig:
 
     @property
     def single_host(self):
+        # ADVICE r2: a cluster with ONE remote host is not single-host —
+        # ports probed here say nothing about where servers bind
         local = {"localhost", "127.0.0.1"}
-        return len(set(self.hosts)) == 1 or set(self.hosts) <= local
+        return set(self.hosts) <= local
 
     def server_endpoints(self, base_port=None):
         """[(host, port)] for every server.
@@ -167,19 +169,26 @@ def _spawn_servers(cfg, endpoints, identify=None):
                 env={**os.environ, "JAX_PLATFORMS": "cpu",
                      "PYTHONPATH": pypath})
         else:
+            import shlex
             ssh = ["ssh"] + (["-i", identify] if identify else []) + [host]
-            p = subprocess.Popen(ssh + [
-                sys.executable, "-m", "hetu_tpu.ps.run_server",
+            remote = " ".join(shlex.quote(a) for a in [
+                "python3", "-m", "hetu_tpu.ps.run_server",
                 str(port), str(cfg.num_workers)])
+            # remote spawns need the package on PYTHONPATH too
+            p = subprocess.Popen(
+                ssh + [f"env PYTHONPATH={shlex.quote(pkg_root)} "
+                       f"JAX_PLATFORMS=cpu {remote}"])
         _procs.append(p)
-    # wait for every local port to accept
+    # wait for every endpoint to accept — remote ones included (a worker
+    # whose PSClient connects before its server binds raises immediately)
     from .ps.server import _port_open
-    deadline = time.time() + 15
+    deadline = time.time() + (15 if all(_is_local(h)
+                                        for h, _ in endpoints) else 60)
     for host, port in endpoints:
-        if not _is_local(host):
-            continue
-        while not _port_open("127.0.0.1", port):
-            assert time.time() < deadline, f"PS server :{port} not up"
+        probe = "127.0.0.1" if _is_local(host) else host
+        while not _port_open(probe, port):
+            assert time.time() < deadline, \
+                f"PS server {host}:{port} not up"
             time.sleep(0.05)
 
 
@@ -219,10 +228,14 @@ def launch_command(cfg, command, identify=None):
                 p = subprocess.Popen(command,
                                      env={**os.environ, **wenv})
             else:
+                import shlex
                 ssh = ["ssh"] + (["-i", identify] if identify else [])
-                exports = " ".join(f"{k}={v}" for k, v in wenv.items())
+                exports = " ".join(
+                    f"{k}={shlex.quote(str(v))}"
+                    for k, v in wenv.items())
+                quoted = " ".join(shlex.quote(c) for c in command)
                 p = subprocess.Popen(
-                    ssh + [host, f"env {exports} " + " ".join(command)])
+                    ssh + [host, f"env {exports} {quoted}"])
             workers.append(p)
             _procs.append(p)
             rank += 1

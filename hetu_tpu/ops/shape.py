@@ -95,16 +95,27 @@ class ArrayReshapeOp(Op):
 
     def deduce_states(self, input_statuses, status, deduce_order):
         """Only a leading-dim split survives a reshape for sure (the
-        reference Reshape.py likewise allows dim-0 splits only); other
-        splits fold into the duplicate axis so downstream ops still see
-        the parallelism degree.
+        reference Reshape.py likewise allows dim-0 splits only), and only
+        when the reshape preserves the leading row blocks: dim 0 of -1
+        (the batch-agnostic pattern) or a dim 0 that divides the input's.
+        A reshape that reorders dim 0 away (e.g. (B,S,D)->(S,B*D)) folds
+        the split into the duplicate axis instead — carrying it would
+        force pathological GSPMD resharding downstream (ADVICE r2).
         """
         st = input_statuses[0]
         if st is None or st.state is None:
             return
         ndim = len(self.output_shape)
         lead = st.state[0] if st.state else 1
-        rest = 1
+        in_shape = getattr(self.inputs[0], "inferred_shape", None)
+        keep_lead = self.output_shape[0] == -1 or (
+            in_shape is not None and in_shape[0] > 0
+            and self.output_shape[0] % in_shape[0] == 0)
+        if not keep_lead:
+            lead, fold = 1, lead
+        else:
+            fold = 1
+        rest = fold
         for p in st.state[1:]:
             rest *= p
         if not deduce_order:

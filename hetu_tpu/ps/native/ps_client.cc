@@ -203,8 +203,19 @@ class Client {
       auto it = parts_.find(id);
       if (it != parts_.end()) return it->second;
     }
-    // unknown tensor (registered by another worker process): whole-tensor
-    // placement on the hashed server, the pre-partitioning behavior
+    if (servers_.size() > 1) {
+      // Guessing whole-tensor placement for a tensor that might be
+      // key-range partitioned across the fleet would silently read one
+      // server's shard as the full tensor (ADVICE r2). Fail loudly:
+      // callers must InitTensor (which registers the partition) first.
+      std::fprintf(stderr,
+                   "[hetu-ps] fatal: tensor %d used before InitTensor "
+                   "with %zu servers — partition unknown; call "
+                   "InitTensor in this process first\n",
+                   id, servers_.size());
+      std::abort();
+    }
+    // single server: whole-tensor placement is the only possibility
     Part p;
     p.offsets = {0, INT64_MAX};
     p.srv = {server_of(id)};
@@ -700,6 +711,21 @@ int SaveParam(int id, const char* path) {
   auto& c = Client::Get();
   auto part = c.part(id);
   int rc_all = 0;
+  if (part.split()) {
+    // manifest records the partition so a later load can detect a fleet
+    // whose ranges no longer match the shard files (ADVICE r2: split
+    // checkpoints were silently tied to the server count at save time)
+    std::FILE* f = std::fopen((std::string(path) + ".manifest").c_str(),
+                              "w");
+    if (f) {
+      std::fprintf(f, "nparts %d\noffsets", part.nparts());
+      for (auto off : part.offsets) {
+        std::fprintf(f, " %lld", static_cast<long long>(off));
+      }
+      std::fprintf(f, "\n");
+      std::fclose(f);
+    }
+  }
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
     w.str(part_path(path, p, part.split()).c_str());
@@ -712,6 +738,23 @@ int SaveParam(int id, const char* path) {
 int LoadParam(int id, const char* path) {
   auto& c = Client::Get();
   auto part = c.part(id);
+  std::FILE* f = std::fopen((std::string(path) + ".manifest").c_str(),
+                            "r");
+  if (f) {
+    int nparts = 0;
+    if (std::fscanf(f, "nparts %d", &nparts) == 1 &&
+        nparts != part.nparts()) {
+      std::fprintf(stderr,
+                   "[hetu-ps] LoadParam(%d): checkpoint %s was saved "
+                   "with %d partitions but the fleet now has %d — "
+                   "resize not supported, restart with the saved "
+                   "server count\n",
+                   id, path, nparts, part.nparts());
+      std::fclose(f);
+      return -22;
+    }
+    std::fclose(f);
+  }
   int rc_all = 0;
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
