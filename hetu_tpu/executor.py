@@ -150,6 +150,12 @@ class HetuConfig:
         if self.mesh is None and self.comm_mode in ("AllReduce", "Hybrid"):
             self.mesh = self._build_dp_mesh()
 
+        # user-inserted pipeline send/recv markers must splice before
+        # parameter materialization walks the graph (pipeline modes)
+        if self.use_gpipe or self.use_pipedream:
+            from .parallel.pipeline import splice_send_recv
+            splice_send_recv(eval_node_list)
+
         # hook pass: splice comm ops (reference executor.py:314)
         topo_sort_with_hook(eval_node_list, self)
 

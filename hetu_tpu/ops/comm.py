@@ -78,12 +78,24 @@ class AllReduceCommunicateOp(Op):
 
 class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
     """All-reduce within a device subgroup (model-parallel replica groups,
-    reference AllReduceCommunicate.py:92-123). The subgroup becomes a mesh
-    sub-axis; lowering is identical."""
+    reference AllReduceCommunicate.py:92-123). ``group`` names the mesh
+    sub-axis the reduction runs over — under shard_map the pmean rides
+    only that axis's links, exactly the reference's NCCL group comm."""
 
     def __init__(self, node_A, group=None, ctx=None):
         super().__init__(node_A, ctx=ctx)
         self.group = group
+
+    def compute(self, input_vals, ectx):
+        val = input_vals[0]
+        axis = self.group or getattr(ectx, "spmd_axis", None) or (
+            ectx.config.spmd_axis if ectx.config is not None else None)
+        if axis is None:
+            return val          # SPMD marker (partitioner reduces)
+        try:
+            return lax.pmean(val, axis)
+        except NameError:
+            return val          # axis not bound in this trace: marker
 
 
 class ParameterServerCommunicateOp(Op):
@@ -169,9 +181,13 @@ class PipelineSendOp(Op):
     value to the next stage's devices (ICI DMA via device_put / ppermute);
     within a traced stage it is identity."""
 
+    registry = []   # construction order; the pipeline planner pairs
+    # each send with its receive (recvs have no input edge to follow)
+
     def __init__(self, node_A, destination=None, comm=None, ctx=None):
         super().__init__(PipelineSendOp, [node_A], ctx)
         self.destination = destination
+        PipelineSendOp.registry.append(self)
 
     def compute(self, input_vals, ectx):
         return input_vals[0]

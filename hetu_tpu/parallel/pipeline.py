@@ -97,6 +97,41 @@ def _device_key(node):
     return ((first.hostname, first.device_id),)
 
 
+def splice_send_recv(eval_nodes, topo=None):
+    """Reference-style explicit PipelineSend/Receive markers: pair them
+    in construction order (send k <-> recv k), bind each recv to its
+    send, and splice consumers through to the payload — the boundary
+    transfer itself is the stage executor's job (device_put over ICI
+    in-process; DCN send/recv when stages span hosts), so the markers
+    carry placement intent, not data. Mutates the graph; call before
+    parameter materialization (HetuConfig does, for pipeline modes)."""
+    if topo is None:
+        topo = find_topo_sort(eval_nodes)
+    recvs = [n for n in topo if isinstance(n, PipelineReceiveOp)]
+    if not recvs:
+        return
+    # a recv has no input edge, so its send is unreachable from the
+    # eval nodes — pull unconsumed sends from the construction registry
+    sends = [s for s in PipelineSendOp.registry
+             if not getattr(s, "_consumed", False)]
+    assert len(sends) >= len(recvs), (
+        f"unpaired pipeline markers: {len(sends)} sends vs "
+        f"{len(recvs)} receives")
+    sends = sends[:len(recvs)]
+    for s in sends:
+        s._consumed = True
+    payload = {}
+    for s, r in zip(sorted(sends, key=lambda n: n.id),
+                    sorted(recvs, key=lambda n: n.id)):
+        r.bound_send = s
+        payload[r] = s.inputs[0]
+        payload[s] = s.inputs[0]
+    for node in topo:
+        if node in payload or not node.inputs:
+            continue
+        node.inputs = [payload.get(i, i) for i in node.inputs]
+
+
 class PipelineSubExecutor:
     """Runs one training subgraph under a pipeline schedule."""
 
@@ -117,8 +152,7 @@ class PipelineSubExecutor:
         # forward graph only: the pipeline differentiates per stage with
         # jax.vjp — the graph-level adjoint subgraph is not traced here
         topo = find_topo_sort(self.eval_nodes)
-        topo = [n for n in topo
-                if not isinstance(n, (PipelineSendOp, PipelineReceiveOp))]
+        topo = self._splice_send_recv(topo)
         self._build_stages(topo)
         self.num_microbatches = num_microbatches or max(
             2, len(self.stages))
@@ -329,6 +363,13 @@ class PipelineSubExecutor:
         outs = stage.fwd(stage.params, ins, feeds[stage.index][m], rng)
         env_out[(m, stage.index)] = outs
         return ins
+
+    # ------------------------------------------------------------------
+    def _splice_send_recv(self, topo):
+        splice_send_recv(self.eval_nodes, topo)
+        topo = find_topo_sort(self.eval_nodes)
+        return [n for n in topo
+                if not isinstance(n, (PipelineSendOp, PipelineReceiveOp))]
 
     # ------------------------------------------------------------------
     def _run_gpipe(self, executor, feeds, M):

@@ -163,3 +163,76 @@ def test_pipedream_with_tp_stage():
     exe = Executor([loss, train_op], pipedream=True, num_microbatches=1)
     pd = _run(exe, x, y_, xs, ys, steps=5, bs=16)
     np.testing.assert_allclose(pd, base, rtol=2e-4, atol=1e-5)
+
+
+def test_explicit_send_recv_markers():
+    """Reference-style explicit pipeline_send/receive markers between
+    stages: spliced by the planner, same losses as the marker-free
+    graph (ops/comm.py PipelineSendOp/PipelineReceiveOp)."""
+    rng = np.random.RandomState(11)
+    w1v = rng.randn(12, 10).astype("f") * 0.3
+    w2v = rng.randn(10, 4).astype("f") * 0.3
+    xs = rng.randn(8, 12).astype("f")
+    ys = np.eye(4, dtype="f")[rng.randint(0, 4, 8)]
+
+    def build(markers):
+        with ht.context(ht.cpu(0)):
+            x = ht.Variable("sr_x", trainable=False)
+            w1 = ht.Variable("sr_w1", value=w1v)
+            a = ht.relu_op(ht.matmul_op(x, w1))
+            if markers:
+                a = ht.pipeline_send_op(a, destination=1)
+        with ht.context(ht.cpu(1)):
+            if markers:
+                recv = ht.pipeline_receive_op(source=0)
+                # reference pairing: the recv stands in for the sent value
+                a_in = recv
+            else:
+                a_in = a
+            w2 = ht.Variable("sr_w2", value=w2v)
+            y_ = ht.Variable("sr_y", trainable=False)
+            logits = ht.matmul_op(a_in, w2)
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(logits, y_), [0])
+            train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        return x, y_, loss, train
+
+    x, y_, loss, train = build(markers=False)
+    exe = Executor([loss, train], gpipe=True, num_microbatches=2)
+    want = [float(np.asarray(exe.run(feed_dict={x: xs, y_: ys}
+                                     )[0].asnumpy())) for _ in range(3)]
+
+    x2, y2, loss2, train2 = build(markers=True)
+    exe2 = Executor([loss2, train2], gpipe=True, num_microbatches=2)
+    got = [float(np.asarray(exe2.run(feed_dict={x2: xs, y2: ys}
+                                     )[0].asnumpy())) for _ in range(3)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_group_allreduce_subgroup_semantics():
+    """GroupAllReduceCommunicateOp pmeans over its named mesh sub-axis
+    only (the reference's NCCL group comm)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from hetu_tpu.ops.comm import GroupAllReduceCommunicateOp
+    from hetu_tpu.graph.node import ExecContext
+
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, axis_names=("a", "b"))
+    xn = ht.Variable("ga_x", trainable=False)
+    op = GroupAllReduceCommunicateOp(xn, group="b")
+    ectx = ExecContext(training=False)
+
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+    def body(v):
+        return op.compute([v], ectx)
+
+    out = shard_map(body, mesh=mesh, in_specs=P("a", "b"),
+                    out_specs=P("a", "b"))(x)
+    want = np.repeat(x.mean(axis=1, keepdims=True), 2, axis=1)
+    np.testing.assert_allclose(np.asarray(out), want)
