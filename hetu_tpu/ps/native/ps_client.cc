@@ -158,6 +158,13 @@ class Client {
     std::vector<int> srv;          // server index per part
     int nparts() const { return static_cast<int>(srv.size()); }
     bool split() const { return srv.size() > 1; }
+    bool synth = false;   // block mode: per-part server-side tensor ids
+    // server-side id for part p: plain id for <=1 range per server
+    // (average), (id << 12) | p when ranges can share a server (block).
+    // Caps: tensor ids < 2^19, parts per tensor < 4096.
+    int32_t pid(int32_t id, int p) const {
+      return synth ? ((id << 12) | p) : id;
+    }
     int part_of(int64_t row) const {
       int lo = 0, hi = nparts() - 1;
       while (lo < hi) {
@@ -169,8 +176,14 @@ class Client {
     int64_t rows_of(int p) const { return offsets[p + 1] - offsets[p]; }
   };
 
-  // Average partition: rows spread evenly over every server (the
-  // trillion-parameter path — no single host needs the whole table).
+  // Partitioners (reference ps-lite partitioner.h):
+  //   Average (default) — rows spread evenly over every server (the
+  //     trillion-parameter path — no single host needs the whole table).
+  //   Block (HETU_PS_PARTITION=block) — fixed-size blocks of
+  //     HETU_PS_BLOCK_SIZE elements assigned round-robin, the
+  //     BytePS-style bounded-per-part scheme (partitioner.h:75-123):
+  //     one huge tensor spreads load without any server owning a range
+  //     proportional to tensor size.
   // Tensors smaller than the fleet stay whole on their hashed server.
   Part make_part(int32_t id, int64_t len, int64_t width) {
     Part p;
@@ -180,6 +193,24 @@ class Client {
     if (ns <= 1 || len < ns) {
       p.offsets = {0, len};
       p.srv = {server_of(id)};
+      return p;
+    }
+    const char* mode = std::getenv("HETU_PS_PARTITION");
+    if (mode && std::strcmp(mode, "block") == 0) {
+      const char* bs = std::getenv("HETU_PS_BLOCK_SIZE");
+      int64_t block_elems = bs ? std::atoll(bs) : 1000000;
+      int64_t block_rows = std::max<int64_t>(
+          block_elems / std::max<int64_t>(width, 1), 1);
+      int64_t off = 0;
+      int s = server_of(id);     // stagger start by tensor
+      p.offsets.push_back(0);
+      while (off < len) {
+        off = std::min(off + block_rows, len);
+        p.offsets.push_back(off);
+        p.srv.push_back(s);
+        s = (s + 1) % ns;
+      }
+      p.synth = p.nparts() > 1;
       return p;
     }
     int64_t base = len / ns, rem = len % ns, off = 0;
@@ -435,7 +466,7 @@ int InitTensor(int id, int ptype, int64_t len, int64_t width, int init_type,
     w.u64(seed + 0x9E3779B9u * static_cast<uint64_t>(p));  // decorrelate
     w.i32(otype);
     w.floats(lrs, static_cast<size_t>(nlr));
-    int rc = c.call(part.srv[p], Op::kInitTensor, id, w, nullptr);
+    int rc = c.call(part.srv[p], Op::kInitTensor, part.pid(id, p), w, nullptr);
     if (rc != 0) rc_all = rc;
   }
   return rc_all;
@@ -448,7 +479,7 @@ int Pull(int id, float* out, int64_t len) {
   for_parts(part.nparts(), [&](int p) {
     std::vector<uint8_t> resp;
     Writer w;
-    rcs[p] = c.call(part.srv[p], Op::kDensePull, id, w, &resp);
+    rcs[p] = c.call(part.srv[p], Op::kDensePull, part.pid(id, p), w, &resp);
     if (rcs[p] != 0) return;
     hetups::Reader rd(resp.data(), resp.size());
     size_t n;
@@ -471,7 +502,7 @@ void Push(int id, const float* grad, int64_t len) {
                                : static_cast<int64_t>(g.size());
       Writer w;
       w.floats(g.data() + off, static_cast<size_t>(n));
-      c.call(part.srv[p], Op::kDensePush, id, w, nullptr);
+      c.call(part.srv[p], Op::kDensePush, part.pid(id, p), w, nullptr);
     }
   });
 }
@@ -488,7 +519,7 @@ void DDPushPull(int id, const float* grad, float* out, int64_t len) {
       Writer w;
       w.floats(g.data() + off, static_cast<size_t>(n));
       std::vector<uint8_t> resp;
-      if (c.call(part.srv[p], Op::kDDPushPull, id, w, &resp) == 0) {
+      if (c.call(part.srv[p], Op::kDDPushPull, part.pid(id, p), w, &resp) == 0) {
         hetups::Reader rd(resp.data(), resp.size());
         size_t m;
         const float* src = rd.floats(&m);
@@ -512,7 +543,7 @@ void SparsePush(int id, const int64_t* idx, const float* vals, int64_t nidx,
       Writer w;
       w.longs(route.idx[p].data(), route.idx[p].size());
       w.floats(pv.data(), pv.size());
-      c.call(part.srv[p], Op::kSparsePush, id, w, nullptr);
+      c.call(part.srv[p], Op::kSparsePush, part.pid(id, p), w, nullptr);
     }
   });
 }
@@ -528,7 +559,7 @@ int SparsePull(int id, const int64_t* idx, float* out, int64_t nidx,
     Writer w;
     w.longs(route.idx[p].data(), route.idx[p].size());
     std::vector<uint8_t> resp;
-    rcs[p] = c.call(part.srv[p], Op::kSparsePull, id, w, &resp);
+    rcs[p] = c.call(part.srv[p], Op::kSparsePull, part.pid(id, p), w, &resp);
     if (rcs[p] != 0) return;
     hetups::Reader rd(resp.data(), resp.size());
     size_t n;
@@ -558,7 +589,7 @@ void SDPushPull(int id, const int64_t* idx, const float* vals, int64_t nidx,
       w.longs(route.idx[p].data(), route.idx[p].size());
       w.floats(pv.data(), pv.size());
       std::vector<uint8_t> resp;
-      if (c.call(part.srv[p], Op::kSDPushPull, id, w, &resp) == 0) {
+      if (c.call(part.srv[p], Op::kSDPushPull, part.pid(id, p), w, &resp) == 0) {
         hetups::Reader rd(resp.data(), resp.size());
         size_t m;
         const float* src = rd.floats(&m);
@@ -588,7 +619,7 @@ void SSPushPull(int id, const int64_t* in_idx, const float* vals,
       w.floats(pv.data(), pv.size());
       w.longs(out_route.idx[p].data(), out_route.idx[p].size());
       std::vector<uint8_t> resp;
-      if (c.call(part.srv[p], Op::kSSPushPull, id, w, &resp) == 0) {
+      if (c.call(part.srv[p], Op::kSSPushPull, part.pid(id, p), w, &resp) == 0) {
         hetups::Reader rd(resp.data(), resp.size());
         size_t n;
         const float* rows = rd.floats(&n);
@@ -621,7 +652,7 @@ int SyncEmbedding(int id, int64_t bound, const int64_t* idx, int64_t* ver,
     w.longs(route.idx[p].data(), route.idx[p].size());
     w.longs(pver.data(), pver.size());
     std::vector<uint8_t> resp;
-    rcs[p] = c.call(part.srv[p], Op::kSyncEmbedding, id, w, &resp);
+    rcs[p] = c.call(part.srv[p], Op::kSyncEmbedding, part.pid(id, p), w, &resp);
     if (rcs[p] != 0) return;
     hetups::Reader rd(resp.data(), resp.size());
     size_t npos, nver, nrows;
@@ -660,7 +691,7 @@ void PushEmbedding(int id, const int64_t* idx, const float* vals,
       w.longs(route.idx[p].data(), route.idx[p].size());
       w.floats(pv.data(), pv.size());
       w.longs(pu.data(), pu.size());
-      c.call(part.srv[p], Op::kPushEmbedding, id, w, nullptr);
+      c.call(part.srv[p], Op::kPushEmbedding, part.pid(id, p), w, nullptr);
     }
   });
 }
@@ -683,7 +714,7 @@ int SetParam(int id, const float* vals, int64_t len) {
     int64_t n = part.split() ? part.rows_of(p) * part.width : len;
     Writer w;
     w.floats(vals + off, static_cast<size_t>(n));
-    int rc = c.call(part.srv[p], Op::kParamSet, id, w, nullptr);
+    int rc = c.call(part.srv[p], Op::kParamSet, part.pid(id, p), w, nullptr);
     if (rc != 0) rc_all = rc;
   }
   return rc_all;
@@ -695,7 +726,7 @@ int Clear(int id) {
   int rc_all = 0;
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
-    int rc = c.call(part.srv[p], Op::kParamClear, id, w, nullptr);
+    int rc = c.call(part.srv[p], Op::kParamClear, part.pid(id, p), w, nullptr);
     if (rc != 0) rc_all = rc;
   }
   return rc_all;
@@ -729,7 +760,7 @@ int SaveParam(int id, const char* path) {
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
     w.str(part_path(path, p, part.split()).c_str());
-    int rc = c.call(part.srv[p], Op::kParamSave, id, w, nullptr);
+    int rc = c.call(part.srv[p], Op::kParamSave, part.pid(id, p), w, nullptr);
     if (rc != 0) rc_all = rc;
   }
   return rc_all;
@@ -759,7 +790,7 @@ int LoadParam(int id, const char* path) {
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
     w.str(part_path(path, p, part.split()).c_str());
-    int rc = c.call(part.srv[p], Op::kParamLoad, id, w, nullptr);
+    int rc = c.call(part.srv[p], Op::kParamLoad, part.pid(id, p), w, nullptr);
     if (rc != 0) rc_all = rc;
   }
   return rc_all;
