@@ -66,14 +66,19 @@ class FlashAttentionOp(Op):
         if _use_pallas():
             # causal is a kernel flag; only the padding mask travels.
             # The logsumexp residual is stashed for the fused backward
-            # (the grad op runs later in the same trace).
+            # (the grad op runs later in the same trace) — but only when
+            # something will consume it: training at a length where the
+            # fused path engages. Otherwise skip the residual write.
             from .pallas_attention import (flash_attention,
                                            flash_attention_with_lse)
-            o, lse = flash_attention_with_lse(
-                q, k, v, mask, sm_scale=self.sm_scale, causal=self.causal)
-            if o is not None:
-                ectx.cache[("flash_res", self.id)] = (o, lse)
-                return o
+            if getattr(ectx, "training", False) and \
+                    q.shape[-2] >= FUSED_BWD_MIN_SEQ:
+                o, lse = flash_attention_with_lse(
+                    q, k, v, mask, sm_scale=self.sm_scale,
+                    causal=self.causal)
+                if o is not None:
+                    ectx.cache[("flash_res", self.id)] = (o, lse)
+                    return o
             return flash_attention(q, k, v, mask, sm_scale=self.sm_scale,
                                    causal=self.causal)
         if self.causal:

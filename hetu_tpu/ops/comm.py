@@ -182,12 +182,26 @@ class PipelineSendOp(Op):
     within a traced stage it is identity."""
 
     registry = []   # construction order; the pipeline planner pairs
-    # each send with its receive (recvs have no input edge to follow)
+    # each send with its receive (recvs have no input edge to follow).
+    # Strong refs — user code usually discards the send handle right
+    # after construction; paired sends are popped at splice time, so
+    # only a built-but-never-run pipeline graph can leave residue (and
+    # the next splice's exact-count check reports it loudly).
 
     def __init__(self, node_A, destination=None, comm=None, ctx=None):
         super().__init__(PipelineSendOp, [node_A], ctx)
         self.destination = destination
         PipelineSendOp.registry.append(self)
+
+    @classmethod
+    def pending(cls):
+        """Unconsumed sends in construction order."""
+        return list(cls.registry)
+
+    @classmethod
+    def consume(cls, sends):
+        for s in sends:
+            cls.registry.remove(s)
 
     def compute(self, input_vals, ectx):
         return input_vals[0]

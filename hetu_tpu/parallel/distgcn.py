@@ -120,13 +120,15 @@ def dist_gcn_spmm(adj, h, mesh):
         # keep size-1 leading mesh dims: [1, 1, gr, nnz]
         perm = [(i, (i - 1) % gr) for i in range(gr)]
 
-        def step(k, carry):
-            z, h_cur = carry
+        def accum(z, k, h_cur):
             d = data[0, 0, k]
-            z = z + jax.ops.segment_sum(
+            return z + jax.ops.segment_sum(
                 h_cur[cols[0, 0, k]] * d[:, None], rows[0, 0, k],
                 num_segments=n_per)
-            return z, lax.ppermute(h_cur, "gr", perm)
+
+        def step(k, carry):
+            z, h_cur = carry
+            return accum(z, k, h_cur), lax.ppermute(h_cur, "gr", perm)
 
         # z accumulates data-derived (gc-varying) terms; mark the zero
         # init as gc-varying too or the scan carry types disagree
@@ -138,7 +140,10 @@ def dist_gcn_spmm(adj, h, mesh):
                 z0 = lax.pvary(z0, ("gc",))
             except AttributeError:  # older jax: vma tracking absent
                 pass
-        z, _ = lax.fori_loop(0, gr, step, (z0, h_local))
+        # gr-1 rotations in the loop; the last block accumulates outside
+        # (a gr-th ppermute would rotate into a discarded carry)
+        z, h_last = lax.fori_loop(0, gr - 1, step, (z0, h_local))
+        z = accum(z, gr - 1, h_last)
         return lax.psum(z, "gc")  # reference row-group allreduce
 
     spec_adj = P("gr", "gc", None, None)

@@ -111,15 +111,16 @@ def splice_send_recv(eval_nodes, topo=None):
     if not recvs:
         return
     # a recv has no input edge, so its send is unreachable from the
-    # eval nodes — pull unconsumed sends from the construction registry
-    sends = [s for s in PipelineSendOp.registry
-             if not getattr(s, "_consumed", False)]
-    assert len(sends) >= len(recvs), (
-        f"unpaired pipeline markers: {len(sends)} sends vs "
-        f"{len(recvs)} receives")
-    sends = sends[:len(recvs)]
-    for s in sends:
-        s._consumed = True
+    # eval nodes — pull unconsumed sends from the construction registry.
+    # Exact pairing: a count mismatch (e.g. stale sends from an
+    # abandoned graph build) fails loudly rather than silently wiring
+    # receives to another graph's payloads.
+    sends = PipelineSendOp.pending()
+    assert len(sends) == len(recvs), (
+        f"unpaired pipeline markers: {len(sends)} pending sends vs "
+        f"{len(recvs)} receives — stale sends from an abandoned graph? "
+        f"build and run pipeline graphs one at a time")
+    PipelineSendOp.consume(sends)
     payload = {}
     for s, r in zip(sorted(sends, key=lambda n: n.id),
                     sorted(recvs, key=lambda n: n.id)):

@@ -160,10 +160,18 @@ class Client {
     bool split() const { return srv.size() > 1; }
     bool synth = false;   // block mode: per-part server-side tensor ids
     // server-side id for part p: plain id for <=1 range per server
-    // (average), (id << 12) | p when ranges can share a server (block).
-    // Caps: tensor ids < 2^19, parts per tensor < 4096.
+    // (average); block mode packs (id, part) into the NEGATIVE id space
+    // so synthetic ids can never collide with another tensor's plain id
+    // (node ids are an unbounded graph counter). Caps enforced below.
     int32_t pid(int32_t id, int p) const {
-      return synth ? ((id << 12) | p) : id;
+      if (!synth) return id;
+      if (id >= (1 << 18) || p >= (1 << 12)) {
+        std::fprintf(stderr,
+                     "[hetu-ps] fatal: block partition id/part overflow "
+                     "(id=%d part=%d)\n", id, p);
+        std::abort();
+      }
+      return -((id << 12) | p) - 1;
     }
     int part_of(int64_t row) const {
       int lo = 0, hi = nparts() - 1;
@@ -773,18 +781,29 @@ int LoadParam(int id, const char* path) {
                             "r");
   if (f) {
     int nparts = 0;
-    if (std::fscanf(f, "nparts %d", &nparts) == 1 &&
-        nparts != part.nparts()) {
-      std::fprintf(stderr,
-                   "[hetu-ps] LoadParam(%d): checkpoint %s was saved "
-                   "with %d partitions but the fleet now has %d — "
-                   "resize not supported, restart with the saved "
-                   "server count\n",
-                   id, path, nparts, part.nparts());
-      std::fclose(f);
-      return -22;
+    bool bad = false;
+    if (std::fscanf(f, "nparts %d", &nparts) == 1) {
+      bad = nparts != part.nparts();
+      if (!bad && std::fscanf(f, " offsets") == 0) {
+        // offsets must match too: equal part counts with different
+        // ranges (e.g. block size changed) would permute rows silently
+        for (int i = 0; i <= nparts && !bad; ++i) {
+          long long off = -1;
+          if (std::fscanf(f, " %lld", &off) != 1 ||
+              off != static_cast<long long>(part.offsets[i]))
+            bad = true;
+        }
+      }
     }
     std::fclose(f);
+    if (bad) {
+      std::fprintf(stderr,
+                   "[hetu-ps] LoadParam(%d): checkpoint %s partition "
+                   "layout (count or offsets) no longer matches the "
+                   "fleet — restart with the saved server count and "
+                   "partitioner settings\n", id, path);
+      return -22;
+    }
   }
   int rc_all = 0;
   for (int p = 0; p < part.nparts(); ++p) {
