@@ -518,8 +518,7 @@ class SubExecutor:
             feed_map[node], first_map[node] = self._stack_feed(
                 [fd[node] for fd in feed_dicts])
         for dl in self.dataloader_ops:
-            stacked = np.stack([np.asarray(dl.get_arr(self.name))
-                                for _ in range(nsteps)])
+            stacked = np.stack(self.dl_block(dl, nsteps))
             feed_map[dl] = self._ingest_stacked(stacked)
             first_map[dl] = stacked[0]
         return self._dispatch_block(executor, feed_map, first_map, nsteps,
@@ -630,7 +629,7 @@ class SubExecutor:
         for node, value in feed_dict.items():
             feed_map[node] = self._ingest(value)
         for dl in self.dataloader_ops:
-            feed_map[dl] = self._ingest(dl.get_arr(self.name))
+            _, feed_map[dl] = self.next_dl_batch(dl)
 
         key = self._shape_key(feed_map)
         if key not in self.compiled:
@@ -658,6 +657,34 @@ class SubExecutor:
             else:
                 results.append(ndarray.NDArray(out, _default_ctx()))
         return results
+
+    def next_dl_batch(self, dl):
+        """(numpy, device) batch for this step, with the FOLLOWING
+        batch's h2d transfer already issued — the reference dataloader's
+        prefetch ring (dataloader.py:26-81): the next batch's DMA
+        overlaps this step's compute instead of starting at the next
+        step's dispatch."""
+        staged = getattr(self, "_dl_staged", None)
+        if staged is None:
+            staged = self._dl_staged = {}
+        cur = staged.get(dl)
+        if cur is None:
+            np_val = np.asarray(dl.get_arr(self.name))
+            cur = (np_val, self._ingest(np_val))
+        np_next = np.asarray(dl.get_arr(self.name))
+        staged[dl] = (np_next, self._ingest(np_next))
+        return cur
+
+    def dl_block(self, dl, nsteps):
+        """``nsteps`` numpy batches in order, honoring any batch the
+        prefetch ring already staged from an interleaved run() call."""
+        out = []
+        staged = getattr(self, "_dl_staged", {}).pop(dl, None)
+        if staged is not None:
+            out.append(staged[0])
+        while len(out) < nsteps:
+            out.append(np.asarray(dl.get_arr(self.name)))
+        return out
 
     def _ingest(self, value):
         """Host value -> device value (with DP batch sharding)."""
@@ -760,6 +787,12 @@ class Executor:
             from .ps.runtime import PSRuntime
             self.ps_runtime = PSRuntime(self, config)
 
+        # -- step timeline (reference profiler/log hooks) --------------
+        self.step_logger = None
+        if config.log_path:
+            from .profiler import StepLogger
+            self.step_logger = StepLogger(config.log_path)
+
     @property
     def base_rng(self):
         return self._base_rng
@@ -776,8 +809,13 @@ class Executor:
             name = "default"
         if name not in self.subexecutors and "default" in self.subexecutors:
             name = "default"
-        return self.subexecutors[name].run(
+        if self.step_logger is not None:
+            self.step_logger.begin()
+        out = self.subexecutors[name].run(
             self, feed_dict, convert_to_numpy_ret_vals)
+        if self.step_logger is not None:
+            self.step_logger.end(self, subgraph=name)
+        return out
 
     def run_batches(self, feed_dicts, name="default",
                     convert_to_numpy_ret_vals=False):

@@ -1,0 +1,125 @@
+"""Tracing / profiling (reference parity: the per-op profiler hooks in
+gpu_ops/executor.py's p32/p16 timer paths and the HetuProfiler).
+
+Three levels:
+
+* ``StepLogger`` — per-step wall-time timeline appended as JSON lines
+  (plus the PS runtime's phase counters when a PS session is active);
+  enabled by ``Executor(..., log_path=...)``.
+* ``profile_ops(executor, feed_dict)`` — per-op timing: runs the step
+  eagerly op by op with a sync after each, returning (and optionally
+  printing) the cost ranking. Eager timing is orders slower than the
+  jitted step — it attributes cost, it does not measure the fused step.
+* ``trace(logdir)`` — context manager over ``jax.profiler`` for XLA/TPU
+  traces viewable in TensorBoard/Perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+import numpy as np
+
+__all__ = ["StepLogger", "profile_ops", "trace"]
+
+
+class StepLogger:
+    """Appends one JSON line per step: wall ms, step index, optional
+    extra phase dict."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = open(path, "a")
+        self._t0 = None
+        self.step = 0
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self, executor=None, **extra):
+        dt = (time.perf_counter() - self._t0) * 1000 \
+            if self._t0 is not None else None
+        rec = {"step": self.step, "wall_ms": round(dt, 3) if dt else None}
+        rt = getattr(executor, "ps_runtime", None) if executor else None
+        if rt is not None:
+            rec["ps_phases_ms"] = {k: round(v * 1000, 3)
+                                   for k, v in rt.times.items() if v}
+        rec.update(extra)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        self.step += 1
+
+    def close(self):
+        self._f.close()
+
+
+def profile_ops(executor, feed_dict=None, name="default", top=20,
+                printout=True):
+    """Per-op cost attribution: execute the step's topo order eagerly,
+    blocking after each op (reference HetuProfiler's per-node timers).
+    Returns [(op_name, ms)] sorted by cost."""
+    import jax
+
+    from .graph.node import ExecContext
+    from .ops.variable import PlaceholderOp
+
+    sub = executor.subexecutors[name]
+    feed_map = {}
+    for node, value in (feed_dict or {}).items():
+        feed_map[node] = sub._ingest(value)
+    for dl in sub.dataloader_ops:
+        feed_map[dl] = sub._ingest(dl.get_arr(sub.name))
+    sub._infer_shapes(feed_map)
+    sub._ensure_state(executor)
+
+    ectx = ExecContext(training=False, base_rng=executor.base_rng,
+                       config=sub.config)
+    ectx.params = {n: executor.params[str(n.id)] for n in sub.param_nodes}
+    ectx.state = {n: executor.state.get(str(n.id), {})
+                  for n in sub.stateful_ops}
+    ectx.opt_state = executor.opt_state
+    ectx.lr = np.float32(0.0)
+    ectx.step = 0
+
+    env = dict(feed_map)
+    times = []
+    for node in sub.topo_order:
+        if node in env or node in sub.optimizer_ops:
+            continue
+        if node in ectx.params:
+            env[node] = ectx.params[node]
+            continue
+        if isinstance(node, PlaceholderOp):
+            env[node] = None
+            continue
+        ins = [env[i] for i in node.inputs]
+        t0 = time.perf_counter()
+        out = node.compute(ins, ectx)
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass                      # pytree values (IndexedSlices etc.)
+        times.append((node.name, (time.perf_counter() - t0) * 1000))
+        env[node] = out
+    times.sort(key=lambda kv: -kv[1])
+    if printout:
+        total = sum(t for _, t in times)
+        print(f"per-op profile ({len(times)} ops, eager total "
+              f"{total:.1f} ms — attribution only; the jitted step "
+              f"fuses these):")
+        for opname, ms in times[:top]:
+            print(f"  {ms:8.3f} ms  {opname}")
+    return times
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """XLA/TPU trace via jax.profiler (TensorBoard/Perfetto viewable)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

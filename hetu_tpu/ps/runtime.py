@@ -249,10 +249,9 @@ class PSRuntime:
             if node in topo_set:
                 feed_map[node] = sub._ingest(value)
         for dl in sub.dataloader_ops:
-            value = dl.get_arr(sub.name)
-            if isinstance(value, np.ndarray):
-                host_feeds[dl] = value
-            feed_map[dl] = sub._ingest(value)
+            np_val, dev_val = sub.next_dl_batch(dl)
+            host_feeds[dl] = np_val
+            feed_map[dl] = dev_val
 
         def host_ids(index_node, what):
             if index_node in host_feeds:
@@ -464,8 +463,7 @@ class PSRuntime:
             feed_map[node], first_map[node] = sub._stack_feed(
                 [fd[node] for fd in feed_dicts])
         for dl in sub.dataloader_ops:
-            stacked = np.stack([np.asarray(dl.get_arr(sub.name))
-                                for _ in range(nsteps)])
+            stacked = np.stack(sub.dl_block(dl, nsteps))
             feed_map[dl] = sub._ingest_stacked(stacked)
             first_map[dl] = stacked[0]
 

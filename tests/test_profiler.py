@@ -1,0 +1,51 @@
+"""Tracing/profiling (reference HetuProfiler + log hooks analogue)."""
+import json
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+from hetu_tpu.profiler import profile_ops
+
+
+def _mlp():
+    x = ht.Variable("pr_x", trainable=False)
+    y_ = ht.Variable("pr_y", trainable=False)
+    w1 = ht.init.xavier_normal((16, 12), name="pr_w1")
+    w2 = ht.init.xavier_normal((12, 4), name="pr_w2")
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return x, y_, loss, train
+
+
+def test_step_timeline(tmp_path):
+    log = str(tmp_path / "steps.jsonl")
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train], log_path=log)
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        exe.run(feed_dict={
+            x: rng.randn(8, 16).astype("f"),
+            y_: np.eye(4, dtype="f")[rng.randint(0, 4, 8)]})
+    exe.step_logger.close()
+    lines = [json.loads(l) for l in open(log)]
+    assert len(lines) == 4
+    assert all(l["wall_ms"] > 0 for l in lines)
+    assert [l["step"] for l in lines] == [0, 1, 2, 3]
+
+
+def test_profile_ops_ranks_cost():
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train])
+    rng = np.random.RandomState(1)
+    feeds = {x: rng.randn(8, 16).astype("f"),
+             y_: np.eye(4, dtype="f")[rng.randint(0, 4, 8)]}
+    exe.run(feed_dict=feeds)
+    times = profile_ops(exe, feeds, printout=False)
+    names = [n for n, _ in times]
+    assert any("MatMul" in n for n in names)
+    assert all(ms >= 0 for _, ms in times)
+    # forward+loss ops all timed
+    assert len(times) >= 5
