@@ -215,3 +215,40 @@ def test_device_cache_save_load(ps_env, tmp_path):
     want = np.concatenate([before[i] for i in range(30)], axis=0)
     np.testing.assert_allclose(after, want, rtol=1e-6)
     exe.close()
+
+
+def test_stale_refresh_sees_other_writer(ps_env, monkeypatch):
+    """Bounded staleness with a second writer: rows another worker
+    pushed (server versions advance past ours + pull_bound) refresh into
+    the device cache on the next batch that touches them."""
+    rng = np.random.RandomState(7)
+    table = rng.randn(20, 4).astype(np.float32)
+
+    ids, y_, loss, train = _embed_model(table, lr=0.0)   # lr 0: reads only
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=0)    # pull_bound 0: any newer version
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    # pretend a second worker exists so the refresh RPC engages
+    monkeypatch.setattr(rt, "nworkers", 2)
+
+    batch = ((np.arange(12) % 6).reshape(4, 3),
+             np.zeros((4, 4), np.float32))
+    exe.run(feed_dict={ids: batch[0], y_: batch[1]})     # rows 0..5 cached
+
+    # "other worker": push updates straight at the server, bumping
+    # per-row versions beyond our client's
+    upd_rows = np.array([1, 3])
+    ps_env.push_embedding(
+        rt.tid, upd_rows, np.full((2, 4), 5.0, np.float32),
+        np.array([1, 1]), 4)
+    ps_env.wait(rt.tid)
+    server_now = ps_env.sparse_pull(rt.tid, upd_rows, 4)
+
+    # next batch touching those rows refreshes them from the server
+    exe.run(feed_dict={ids: batch[0], y_: batch[1]})
+    import jax
+    cache = np.asarray(exe.params[rt.cache_sid])
+    slots = rt._lookup_slots(upd_rows.astype(np.int64))
+    np.testing.assert_allclose(cache[slots], server_now, rtol=1e-6)
+    assert rt.pulled_rows >= 8    # 6 misses + 2 refreshes
+    exe.close()
