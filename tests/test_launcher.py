@@ -103,3 +103,72 @@ def test_heturun_end_to_end(tmp_path):
         # (async 2-worker PS is noisy, so compare half-means)
         assert np.mean(losses[10:]) < np.mean(losses[:10]), \
             f"worker {rank}: {losses}"
+
+
+DEVICE_CACHE_WORKER = """
+import os
+import numpy as np
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+
+rank = int(os.environ["HETU_PS_RANK"])
+rng = np.random.RandomState(0)
+emb_val = rng.randn(50, 8).astype("f") * 0.1
+w_val = rng.randn(8 * 4 + 5, 1).astype("f") * 0.1
+dense = ht.Variable("dense", trainable=False)
+sparse = ht.Variable("sparse", trainable=False)
+y_ = ht.Variable("y_", trainable=False)
+emb = ht.Variable("ctr_embedding", value=emb_val)
+w = ht.Variable("ctr_w", value=w_val)
+look = ht.embedding_lookup_op(emb, sparse)
+flat = ht.array_reshape_op(look, (-1, 8 * 4))
+feats = ht.concat_op(flat, dense, axis=1)
+y = ht.sigmoid_op(ht.matmul_op(feats, w))
+loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+train_op = ht.optim.SGDOptimizer(learning_rate=0.3).minimize(loss)
+# the HET device-cache path: HBM rows, bounded staleness, 2 workers
+exe = Executor([loss, train_op], ctx=ht.cpu(0), comm_mode="PS",
+               cstable_policy="Device", cache_bound=3)
+frng = np.random.RandomState(1 + rank)
+losses = []
+for _ in range(25):
+    d = frng.randn(16, 5).astype("f")
+    s = frng.randint(0, 50, (16, 4))
+    yv = (d[:, :1] > 0).astype("f")
+    losses.append(exe.run(feed_dict={dense: d, sparse: s, y_: yv}
+                          )[0].asnumpy().item())
+exe.close()
+rt = next(iter(exe.ps_runtime.device_tables.values()))
+out = os.path.join(os.environ["HETU_TEST_OUT"], f"dcl_{rank}.txt")
+with open(out, "w") as f:
+    f.write(" ".join(str(x) for x in losses))
+    f.write("\\nperf " + str(rt.perf))
+"""
+
+
+def test_heturun_device_cache_two_workers(tmp_path):
+    """2 servers + 2 workers with the HBM device cache: bounded-staleness
+    drains and refreshes run against a live multi-worker fleet; both
+    workers' planted-signal losses must fall."""
+    cfg_path = tmp_path / "cluster.yml"
+    cfg_path.write_text(CONFIG)
+    script = tmp_path / "train_dc.py"
+    script.write_text(DEVICE_CACHE_WORKER)
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           "JAX_PLATFORMS": "cpu",
+           "HETU_TEST_OUT": str(tmp_path)}
+    env.pop("HETU_PS_HOSTS", None)
+    env.pop("HETU_PS_PORTS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "hetu_tpu.launcher", "-c", str(cfg_path),
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for rank in range(2):
+        path = tmp_path / f"dcl_{rank}.txt"
+        assert path.exists(), f"worker {rank} wrote no losses"
+        first = path.read_text().splitlines()[0]
+        losses = [float(x) for x in first.split()]
+        assert losses[-1] < losses[0], (rank, losses[0], losses[-1])
