@@ -659,31 +659,40 @@ class SubExecutor:
         return results
 
     def next_dl_batch(self, dl):
-        """(numpy, device) batch for this step, with the FOLLOWING
+        """(host, device) batch for this step, with the FOLLOWING
         batch's h2d transfer already issued — the reference dataloader's
         prefetch ring (dataloader.py:26-81): the next batch's DMA
         overlaps this step's compute instead of starting at the next
-        step's dispatch."""
+        step's dispatch.
+
+        GNN loaders are exempt: their double-buffer contract hands the
+        trainer a graph to mutate between steps, so reading one step
+        ahead would train on the previous iteration's graph."""
+        if isinstance(dl, GNNDataLoaderOp):
+            value = dl.get_arr(self.name)
+            return value, self._ingest(value)
         staged = getattr(self, "_dl_staged", None)
         if staged is None:
             staged = self._dl_staged = {}
         cur = staged.get(dl)
         if cur is None:
-            np_val = np.asarray(dl.get_arr(self.name))
-            cur = (np_val, self._ingest(np_val))
-        np_next = np.asarray(dl.get_arr(self.name))
-        staged[dl] = (np_next, self._ingest(np_next))
+            value = dl.get_arr(self.name)
+            cur = (value, self._ingest(value))
+        nxt = dl.get_arr(self.name)
+        staged[dl] = (nxt, self._ingest(nxt))
         return cur
 
     def dl_block(self, dl, nsteps):
-        """``nsteps`` numpy batches in order, honoring any batch the
-        prefetch ring already staged from an interleaved run() call."""
+        """``nsteps`` host batches in order, honoring any batch the
+        prefetch ring already staged from an interleaved run() call
+        (the staged device copy is dropped — a one-transfer cost at the
+        run() -> run_batches() transition only)."""
         out = []
         staged = getattr(self, "_dl_staged", {}).pop(dl, None)
         if staged is not None:
             out.append(staged[0])
         while len(out) < nsteps:
-            out.append(np.asarray(dl.get_arr(self.name)))
+            out.append(dl.get_arr(self.name))
         return out
 
     def _ingest(self, value):
@@ -883,9 +892,13 @@ class Executor:
         return {}
 
     def close(self):
-        """Flush in-flight PS work (ASP pushes, device-cache drains)."""
+        """Flush in-flight PS work (ASP pushes, device-cache drains) and
+        release the step logger's file handle."""
         if self.ps_runtime is not None:
             self.ps_runtime.close()
+        if self.step_logger is not None:
+            self.step_logger.close()
+            self.step_logger = None
 
     def __del__(self):
         pass

@@ -32,6 +32,7 @@ class StepLogger:
         self.path = path
         self._f = open(path, "a")
         self._t0 = None
+        self._phase_snap = {}
         self.step = 0
 
     def begin(self):
@@ -43,8 +44,13 @@ class StepLogger:
         rec = {"step": self.step, "wall_ms": round(dt, 3) if dt else None}
         rt = getattr(executor, "ps_runtime", None) if executor else None
         if rt is not None:
+            # rt.times accumulates for the runtime's life: log the DELTA
+            # since the previous step, which is this step's cost
+            delta = {k: v - self._phase_snap.get(k, 0.0)
+                     for k, v in rt.times.items()}
+            self._phase_snap = dict(rt.times)
             rec["ps_phases_ms"] = {k: round(v * 1000, 3)
-                                   for k, v in rt.times.items() if v}
+                                   for k, v in delta.items() if v > 0}
         rec.update(extra)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()

@@ -249,8 +249,9 @@ class PSRuntime:
             if node in topo_set:
                 feed_map[node] = sub._ingest(value)
         for dl in sub.dataloader_ops:
-            np_val, dev_val = sub.next_dl_batch(dl)
-            host_feeds[dl] = np_val
+            host_val, dev_val = sub.next_dl_batch(dl)
+            if isinstance(host_val, np.ndarray):
+                host_feeds[dl] = host_val
             feed_map[dl] = dev_val
 
         def host_ids(index_node, what):

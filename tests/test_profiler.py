@@ -29,7 +29,7 @@ def test_step_timeline(tmp_path):
         exe.run(feed_dict={
             x: rng.randn(8, 16).astype("f"),
             y_: np.eye(4, dtype="f")[rng.randint(0, 4, 8)]})
-    exe.step_logger.close()
+    exe.close()      # closes the step logger too
     lines = [json.loads(l) for l in open(log)]
     assert len(lines) == 4
     assert all(l["wall_ms"] > 0 for l in lines)
