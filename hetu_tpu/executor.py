@@ -282,6 +282,31 @@ class HetuConfig:
         return self.node_spec.get(node)
 
 
+class _BlockStep:
+    """Lazy per-step view into a block's stacked output: the slice op
+    dispatches only if this step's value is actually read."""
+
+    __slots__ = ("stacked", "k")
+
+    def __init__(self, stacked, k):
+        self.stacked = stacked
+        self.k = k
+
+    @property
+    def jax_array(self):
+        return self.stacked[self.k]
+
+    def asnumpy(self):
+        return np.asarray(self.stacked[self.k])
+
+    def __array__(self, dtype=None):
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
+    def __float__(self):
+        return float(self.asnumpy())
+
+
 class SubExecutor:
     """Executes one eval subgraph (reference executor.py:1340-1864).
 
@@ -557,16 +582,22 @@ class SubExecutor:
     def _split_block_outputs(self, outs, nsteps, convert):
         out_is_none = [n in set(self.optimizer_ops)
                        for n in self.eval_node_list]
+        if convert:
+            # one host transfer per stacked output, then numpy indexing
+            outs = [np.asarray(o) for o in outs]
         results = []
         for k in range(nsteps):
             row, it = [], iter(outs)
             for none in out_is_none:
                 if none:
                     row.append(None)
+                elif convert:
+                    row.append(next(it)[k])
                 else:
-                    v = next(it)[k]
-                    row.append(np.asarray(v) if convert
-                               else ndarray.NDArray(v, None))
+                    # lazy view: slicing a device array dispatches an op,
+                    # and nsteps x outputs of them per block would cost
+                    # more queue time than the block itself
+                    row.append(_BlockStep(next(it), k))
             results.append(row)
         return results
 
