@@ -28,10 +28,12 @@ MLP_BASELINE_MS = 3.0
 GCN_BASELINE_MS = 150.0
 
 
-def emit(metric, value, unit, vs):
-    print(json.dumps({"metric": metric, "value": round(float(value), 1),
-                      "unit": unit, "vs_baseline": round(float(vs), 3)}),
-          flush=True)
+def emit(metric, value, unit, vs, **extra):
+    rec = {"metric": metric, "value": round(float(value), 1),
+           "unit": unit, "vs_baseline": round(float(vs), 3)}
+    for k, v in extra.items():
+        rec[k] = round(float(v), 1) if isinstance(v, float) else v
+    print(json.dumps(rec), flush=True)
 
 
 def _pin(feeds):
@@ -51,19 +53,20 @@ def _pin(feeds):
     return out
 
 
-def _time_steps(run, steps, windows=1):
-    """Best-of-N measurement windows (the remote-tunnel link's latency
-    swings run to run; the best window is the steady-state capability)."""
+def _time_steps(run, steps, windows=3):
+    """(best, median) window times. Best is the steady-state capability
+    (the remote-tunnel link's latency swings run to run); median is the
+    reproducible number the driver can expect on a re-run (round-4
+    bench-hygiene ask: report both)."""
     run()[0].asnumpy()                    # settle dispatch queue
-    best = None
+    times = []
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(steps):
             out = run()
         out[0].asnumpy()                  # one sync for the whole window
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    return best
+        times.append(time.perf_counter() - t0)
+    return min(times), float(np.median(times))
 
 
 def bench_logreg():
@@ -315,9 +318,11 @@ def bench_gcn():
     for _ in range(3):
         exe.run(feed_dict=feeds)
     steps = 20
-    dt = _time_steps(lambda: exe.run(feed_dict=feeds), steps, windows=2)
-    ms = dt / steps * 1000
-    emit("gcn_arxiv_epoch_time", ms, "ms/epoch", GCN_BASELINE_MS / ms)
+    best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps,
+                            windows=2)
+    ms = best / steps * 1000
+    emit("gcn_arxiv_epoch_time", ms, "ms/epoch", GCN_BASELINE_MS / ms,
+         median=med / steps * 1000)
 
 
 def bench_bert():
@@ -368,33 +373,65 @@ def bench_bert():
 
 def bench_pp():
     """Pipeline-parallel step-time microbench: 2-stage GPipe MLP, 4
-    microbatches (stages share the one real chip here; the number tracks
-    schedule + dispatch overhead, which is what the async cleanup
-    targets — no host syncs inside the microbatch loops)."""
+    microbatches, compiled schedule. On this one-chip bench host
+    cpu(0)/cpu(1) resolve to the same device, so the two stages
+    co-reside and the whole schedule fuses into ONE jitted dispatch per
+    step (asserted below); the per-stage scan-block path (2S-1
+    dispatches) is exercised on the multi-device CPU harness by
+    tests/test_pipeline.py. Anchor: the SAME model trained in one plain
+    single-chip executor; vs_baseline = single_step / pp_step, an honest
+    in-repo anchor instead of the round-3 hardcoded 1.0."""
     import hetu_tpu as ht
     from hetu_tpu.executor import Executor
 
     rng = np.random.RandomState(0)
-    with ht.context(ht.cpu(0)):
-        x = ht.Variable("x", trainable=False)
-        w1 = ht.Variable("w1", value=rng.randn(256, 512).astype("f") * .05)
-        a = ht.relu_op(ht.matmul_op(x, w1))
-    with ht.context(ht.cpu(0)):
-        w2 = ht.Variable("w2", value=rng.randn(512, 64).astype("f") * .05)
-        logits = ht.matmul_op(a, w2)
-        y_ = ht.Variable("y_", trainable=False)
-        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y_),
-                                 [0])
-        train_op = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    def build(staged):
+        c0 = ht.cpu(0)
+        c1 = ht.cpu(1) if staged else ht.cpu(0)
+        with ht.context(c0):
+            x = ht.Variable("x", trainable=False)
+            w1 = ht.Variable("w1",
+                             value=rng.randn(256, 512).astype("f") * .05)
+            a = ht.relu_op(ht.matmul_op(x, w1))
+        with ht.context(c1):
+            w2 = ht.Variable("w2",
+                             value=rng.randn(512, 64).astype("f") * .05)
+            logits = ht.matmul_op(a, w2)
+            y_ = ht.Variable("y_", trainable=False)
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(logits, y_), [0])
+            train_op = ht.optim.SGDOptimizer(
+                learning_rate=0.05).minimize(loss)
+        return x, y_, loss, train_op
+
+    xv = rng.randn(64, 256).astype("f")
+    yv = np.eye(64, dtype="f")[rng.randint(0, 64, 64)]
+    steps = 30
+
+    x, y_, loss, train_op = build(staged=False)
+    base_exe = Executor([loss, train_op])
+    base_feeds = _pin({x: xv, y_: yv})
+    for _ in range(3):
+        base_exe.run(feed_dict=base_feeds)
+    base_dt, _ = _time_steps(lambda: base_exe.run(feed_dict=base_feeds),
+                             steps, windows=2)
+    base_ms = base_dt / steps * 1000
+
+    x, y_, loss, train_op = build(staged=True)
     exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
-    feeds = {x: rng.randn(64, 256).astype("f"),
-             y_: np.eye(64, dtype="f")[rng.randint(0, 64, 64)]}
+    sub = exe.subexecutors["default"]
+    assert len(sub.stages) == 2
+    feeds = _pin({x: xv, y_: yv})
     for _ in range(3):
         exe.run(feed_dict=feeds)
-    steps = 30
-    dt = _time_steps(lambda: exe.run(feed_dict=feeds), steps)
-    ms = dt / steps * 1000
-    emit("pp_gpipe_2stage_step_time", ms, "ms/step", 1.0)
+    # pin which code path this metric measures (see docstring)
+    assert sub._fused_step is not None, \
+        "expected co-resident stages to fuse on the 1-chip bench host"
+    best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps)
+    ms = best / steps * 1000
+    emit("pp_gpipe_2stage_step_time", ms, "ms/step", base_ms / ms,
+         median=med / steps * 1000, single_chip_anchor_ms=base_ms)
 
 
 def bench_bert_long_seq():
@@ -463,6 +500,13 @@ def main():
         # device buffers so configs don't contend for HBM
         gc.collect()
         jax.clear_caches()
+    # hard exit: every metric is already flushed, and a lingering
+    # non-daemon thread (PS server, tunnel client) must not turn a
+    # finished run into the driver's timeout rc=124 (round-3 postmortem)
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -863,7 +863,15 @@ class Executor:
         (lax.scan block) — same math as sequential ``run`` calls, with
         per-invocation host overhead amortized by 1/len(feed_dicts).
         Returns a list of per-step output lists."""
+        if name not in self.subexecutors and "default" in self.subexecutors:
+            name = "default"
         sub = self.subexecutors[name]
+        from .parallel.pipeline import PipelineSubExecutor
+        if isinstance(sub, PipelineSubExecutor):
+            raise ValueError(
+                "run_batches is not supported for gpipe/pipedream "
+                "executors — the pipeline schedule already amortizes "
+                "dispatch over microbatches; call run() per step")
         needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
                     or sub.cached_lookups)
         if needs_ps:

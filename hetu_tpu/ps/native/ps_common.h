@@ -62,14 +62,22 @@ enum class InitKind : int32_t {
 };
 
 struct MsgHeader {
-  uint32_t magic = 0x48505331;  // "HPS1"
+  uint32_t magic = 0x48505332;  // "HPS2" (v2: adds worker+seq)
   uint32_t op = 0;
   int32_t tensor_id = 0;
   int32_t status = 0;           // response: 0 ok
   uint64_t payload_len = 0;     // bytes after header
+  // request identity for at-most-once retry semantics (reference
+  // ps-lite resender.h tracks message signatures the same way): the
+  // client retries a call whose connection died or timed out; the
+  // server dedups mutating ops on (worker, seq) so a push whose
+  // response was lost is not applied twice.
+  uint32_t worker = 0;
+  uint32_t reserved = 0;
+  uint64_t seq = 0;
 };
 
-static_assert(sizeof(MsgHeader) == 24, "header layout");
+static_assert(sizeof(MsgHeader) == 40, "header layout");
 
 // ---------------------------------------------------------------------------
 // payload (de)serialization helpers

@@ -18,6 +18,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdio>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -25,6 +26,7 @@
 #include <shared_mutex>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "ps_common.h"
@@ -292,13 +294,13 @@ class Server {
     std::vector<uint8_t> payload;
     for (;;) {
       MsgHeader h;
-      if (!read_full(fd, &h, sizeof h) || h.magic != 0x48505331) break;
+      if (!read_full(fd, &h, sizeof h) || h.magic != 0x48505332) break;
       payload.resize(h.payload_len);
       if (h.payload_len && !read_full(fd, payload.data(), h.payload_len))
         break;
       Writer out;
       int32_t status = handle(static_cast<Op>(h.op), h.tensor_id,
-                              payload, out);
+                              payload, out, h.worker, h.seq);
       MsgHeader rh;
       rh.op = h.op;
       rh.tensor_id = h.tensor_id;
@@ -324,8 +326,25 @@ class Server {
     ::close(fd);
   }
 
+  // at-most-once retry protection (reference ps-lite resender.h): a
+  // client retries a request whose connection died after the server may
+  // already have applied it; mutating ops are deduped on (worker, seq)
+  // so the retry serves only the read part. Returns true if duplicate.
+  bool check_and_record(uint32_t worker, uint64_t seq) {
+    std::lock_guard<std::mutex> l(dedup_mu_);
+    auto& d = dedup_[worker];
+    if (d.seen.count(seq)) return true;
+    d.seen.insert(seq);
+    d.order.push_back(seq);
+    if (d.order.size() > 65536) {
+      d.seen.erase(d.order.front());
+      d.order.pop_front();
+    }
+    return false;
+  }
+
   int32_t handle(Op op, int32_t id, const std::vector<uint8_t>& payload,
-                 Writer& out) {
+                 Writer& out, uint32_t worker, uint64_t seq) {
     Reader rd(payload.data(), payload.size());
     switch (op) {
       case Op::kInitTensor: {
@@ -394,8 +413,10 @@ class Server {
         if (!t) return -1;
         size_t n;
         const float* g = rd.floats(&n);
+        bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
-        if (static_cast<int64_t>(n) == t->nelem()) t->apply_dense(g);
+        if (!dup && static_cast<int64_t>(n) == t->nelem())
+          t->apply_dense(g);
         if (op == Op::kDDPushPull)
           out.floats(t->data.data(), t->data.size());
         bytes_in_ += n * 4;
@@ -420,8 +441,9 @@ class Server {
         size_t nidx, nval;
         const int64_t* idx = rd.longs(&nidx);
         const float* g = rd.floats(&nval);
+        bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
-        t->apply_sparse(idx, nidx, g);
+        if (!dup) t->apply_sparse(idx, nidx, g);
         bytes_in_ += nval * 4;
         return 0;
       }
@@ -432,8 +454,9 @@ class Server {
         size_t nidx, nval;
         const int64_t* idx = rd.longs(&nidx);
         const float* g = rd.floats(&nval);
+        bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
-        t->apply_sparse(idx, nidx, g);
+        if (!dup) t->apply_sparse(idx, nidx, g);
         out.floats(t->data.data(), t->data.size());
         return 0;
       }
@@ -447,8 +470,9 @@ class Server {
         const int64_t* in_idx = rd.longs(&nin);
         const float* g = rd.floats(&nval);
         const int64_t* out_idx = rd.longs(&nout);
+        bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
-        t->apply_sparse(in_idx, nin, g);
+        if (!dup) t->apply_sparse(in_idx, nin, g);
         out.i64(static_cast<int64_t>(nout * t->width));
         size_t off = out.buf.size();
         out.buf.resize(off + nout * t->width * sizeof(float));
@@ -493,6 +517,8 @@ class Server {
         const int64_t* idx = rd.longs(&nidx);
         const float* g = rd.floats(&nval);
         const int64_t* upd = rd.longs(&nupd);  // per-row update counts
+        bool dup = check_and_record(worker, seq);
+        if (dup) return 0;
         std::unique_lock<std::shared_mutex> l(t->mu);
         t->apply_sparse(idx, nidx, g);
         if (!t->ver.empty())
@@ -511,11 +537,14 @@ class Server {
         const int64_t* upd = rd.longs(&nupd);
         const int64_t* sidx = rd.longs(&nsidx);
         const int64_t* sver = rd.longs(&nsver);
+        bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
-        t->apply_sparse(pidx, npidx, g);
-        for (size_t j = 0; j < nupd && j < npidx; ++j)
-          if (pidx[j] >= 0 && pidx[j] < t->len)
-            t->ver[pidx[j]] += upd[j] - 1;
+        if (!dup) {
+          t->apply_sparse(pidx, npidx, g);
+          for (size_t j = 0; j < nupd && j < npidx; ++j)
+            if (pidx[j] >= 0 && pidx[j] < t->len)
+              t->ver[pidx[j]] += upd[j] - 1;
+        }
         std::vector<int64_t> stale_pos, stale_ver;
         std::vector<float> rows;
         for (size_t j = 0; j < nsidx; ++j) {
@@ -586,6 +615,34 @@ class Server {
       }
       case Op::kBarrier: {
         std::unique_lock<std::mutex> l(bar_mu_);
+        // a retried barrier (first registration's response was lost)
+        // must not count the worker twice: wait out the generation the
+        // original registration joined, then succeed
+        bool is_dup = false;
+        int reg_gen = 0;
+        {
+          std::lock_guard<std::mutex> dl(dedup_mu_);
+          auto& d = dedup_[worker];
+          auto it = d.bar_gen.find(seq);
+          if (it != d.bar_gen.end()) {
+            is_dup = true;
+            reg_gen = it->second;
+          } else {
+            d.bar_gen[seq] = bar_gen_;
+            d.bar_order.push_back(seq);
+            if (d.bar_order.size() > 1024) {
+              // evict the OLDEST registration — live retries target
+              // recent barriers, so insertion-order pruning never
+              // drops an in-flight retry's dedup entry
+              d.bar_gen.erase(d.bar_order.front());
+              d.bar_order.pop_front();
+            }
+          }
+        }
+        if (is_dup) {
+          bar_cv_.wait(l, [&] { return bar_gen_ != reg_gen; });
+          return 0;
+        }
         int gen = bar_gen_;
         if (++bar_count_ >= nworkers_) {
           bar_count_ = 0;
@@ -633,6 +690,15 @@ class Server {
   std::condition_variable bar_cv_;
   int bar_count_ = 0;
   int bar_gen_ = 0;
+  // per-worker (seq) dedup for at-most-once mutating ops
+  struct WorkerDedup {
+    std::unordered_set<uint64_t> seen;
+    std::deque<uint64_t> order;
+    std::unordered_map<uint64_t, int> bar_gen;  // barrier seq -> gen
+    std::deque<uint64_t> bar_order;             // insertion order
+  };
+  std::mutex dedup_mu_;
+  std::unordered_map<uint32_t, WorkerDedup> dedup_;
   std::atomic<uint64_t> bytes_in_{0};
 };
 
