@@ -26,6 +26,9 @@ WDL_BASELINE_SPS = 60000.0
 LOGREG_BASELINE_MS = 1.5
 MLP_BASELINE_MS = 3.0
 GCN_BASELINE_MS = 150.0
+# NCF batch1024 on a V100-class chip: ~3.5ms/step through the reference's
+# PS embedding path (examples/rec/run_hetu.py prints per-epoch time)
+NCF_BASELINE_SPS = 300000.0
 
 
 def emit(metric, value, unit, vs, **extra):
@@ -278,6 +281,67 @@ def bench_wdl_hybrid():
         ps_server.shutdown_server()
 
 
+def bench_ncf():
+    """NCF (NeuMF) on MovieLens-25M dimensions, Hybrid mode: user/item
+    embedding tables through the HBM device cache + host PS, dense tower
+    in-graph — the reference's canonical Hybrid rec workload
+    (examples/rec/hybrid_ncf.sh)."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    from hetu_tpu.models.ncf import neural_mf, ML25M_USERS, ML25M_ITEMS
+    from hetu_tpu.ps import server as ps_server
+    from hetu_tpu.ps import client as ps_client
+
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    try:
+        batch = 1024
+        rng = np.random.RandomState(0)
+        user = ht.Variable("user_input", trainable=False)
+        item = ht.Variable("item_input", trainable=False)
+        y_ = ht.Variable("y_", trainable=False)
+        loss, y, train_op = neural_mf(
+            user, item, y_, ML25M_USERS, ML25M_ITEMS,
+            embed_ctx=ht.cpu(0))
+        exe = Executor([loss, train_op], comm_mode="Hybrid",
+                       cstable_policy="Device", cache_bound=50)
+        ncycle = 100
+        users_in = rng.randint(0, ML25M_USERS, (ncycle, batch))
+        # items zipf-skewed like real MovieLens popularity
+        items_in = (rng.zipf(1.3, size=(ncycle, batch)) - 1) % ML25M_ITEMS
+        y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+        kblock = 100
+
+        def block(i0):
+            return [{user: users_in[(i0 + j) % ncycle],
+                     item: items_in[(i0 + j) % ncycle],
+                     y_: y_in} for j in range(kblock)]
+
+        for i0 in range(0, ncycle + kblock, kblock):
+            out = exe.run_batches(block(i0))
+        out[-1][0].asnumpy()
+        steps = 300
+        sps_all = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i0 in range(0, steps, kblock):
+                out = exe.run_batches(block(i0))
+            out[-1][0].asnumpy()
+            sps_all.append(steps * batch / (time.perf_counter() - t0))
+        emit("ncf_ml25m_hybrid_samples_per_sec_per_chip", max(sps_all),
+             "samples/sec/chip", max(sps_all) / NCF_BASELINE_SPS,
+             median=float(np.median(sps_all)))
+        exe.close()
+    finally:
+        client.shutdown_servers()
+        ps_client.close_default_client()
+        ps_server.shutdown_server()
+
+
 def bench_gcn():
     """Full-batch GCN at OGB-arxiv scale (169k nodes, ~1.2M edges):
     epoch (= full-graph step) time."""
@@ -486,7 +550,7 @@ def main():
     import jax
 
     for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
-               bench_wdl_hybrid, bench_gcn, bench_pp,
+               bench_wdl_hybrid, bench_ncf, bench_gcn, bench_pp,
                bench_bert_long_seq, bench_bert):
         try:
             fn()

@@ -50,6 +50,11 @@ def load_dataset(name, model):
         if conv:
             tx = tx.reshape(-1, 1, 28, 28)
             vx = vx.reshape(-1, 1, 28, 28)
+    elif name == "DIGITS":
+        # the checked-in real shard (hetu_tpu/data.py digits()) — dense
+        # models only (8x8 images are below the conv stacks' geometry)
+        assert not conv, "DIGITS supports dense models (logreg/mlp)"
+        (tx, ty), (vx, vy), _ = ht.data.digits()
     elif name in ("CIFAR10", "CIFAR100"):
         loader = ht.data.cifar10 if name == "CIFAR10" else ht.data.cifar100
         tx, ty, vx, vy = loader()
@@ -72,7 +77,12 @@ def run(args):
                           ht.Dataloader(vx, args.batch_size, "validate")])
     y_ = ht.dataloader_op([ht.Dataloader(ty, args.batch_size, "train"),
                            ht.Dataloader(vy, args.batch_size, "validate")])
-    loss, y = model(x, y_)
+    kwargs = {}
+    if args.model in ("logreg", "mlp"):
+        # dense models take the flattened feature width of whatever
+        # dataset was loaded (784 MNIST, 64 DIGITS, 3072 CIFAR)
+        kwargs["input_dim"] = int(tx.shape[1])
+    loss, y = model(x, y_, **kwargs)
     opt = build_optimizer(args.opt, args.learning_rate)
     train_op = opt.minimize(loss)
 

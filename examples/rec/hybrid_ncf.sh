@@ -1,0 +1,6 @@
+#!/bin/bash
+# Hybrid-mode NCF: embeddings via PS device cache, dense tower AllReduce
+# (reference parity: examples/rec/hybrid_ncf.sh)
+cd "$(dirname "$0")"
+../../bin/heturun -c settings/local_ps.yml \
+    python run_hetu.py --comm Hybrid --cache Device --timing "$@"

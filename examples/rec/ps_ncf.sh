@@ -1,0 +1,5 @@
+#!/bin/bash
+# PS-mode NCF (reference parity: examples/rec/ps_ncf.sh)
+cd "$(dirname "$0")"
+../../bin/heturun -c settings/local_ps.yml \
+    python run_hetu.py --comm PS --cache Device --timing "$@"

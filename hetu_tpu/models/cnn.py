@@ -56,10 +56,10 @@ def conv_bn_relu(x, in_channel, out_channel, name):
 # simple models
 # ---------------------------------------------------------------------------
 
-def logreg(x, y_):
+def logreg(x, y_, input_dim=784, num_classes=10):
     """Logistic regression on MNIST (reference models/LogReg.py)."""
-    weight = init.zeros((784, 10), name="logreg_weight")
-    bias = init.zeros((10,), name="logreg_bias")
+    weight = init.zeros((input_dim, num_classes), name="logreg_weight")
+    bias = init.zeros((num_classes,), name="logreg_bias")
     y = matmul_op(x, weight)
     y = y + broadcastto_op(bias, y)
     loss = reduce_mean_op(softmaxcrossentropy_op(y, y_), [0])

@@ -203,9 +203,13 @@ def _expand(im, node):
 def _conv(im, node):
     pads = _attr_ints(node, "pads", [0, 0, 0, 0])
     strides = _attr_ints(node, "strides", [1, 1])
-    im.env[node.outputs[0]] = ops.conv2d_op(
+    out = ops.conv2d_op(
         im.materialize(node.inputs[0]), im.materialize(node.inputs[1]),
         padding=pads[0], stride=strides[0])
+    if len(node.inputs) > 2:      # [C_out] bias over [N,C,H,W]
+        out = out + ops.conv2d_broadcastto_op(
+            im.materialize(node.inputs[2]), out)
+    im.env[node.outputs[0]] = out
 
 
 @imports("MaxPool", "AveragePool")
@@ -247,6 +251,157 @@ def _onehot(im, node):
     depth = int(np.asarray(im.const(node.inputs[1])).ravel()[0])
     im.env[node.outputs[0]] = ops.one_hot_op(
         im.materialize(node.inputs[0]), depth)
+
+
+@imports("Sub")
+def _sub(im, node):
+    a = im.materialize(node.inputs[0])
+    b = im.materialize(node.inputs[1])
+    im.env[node.outputs[0]] = ops.add_op(a, ops.opposite_op(b))
+
+
+@imports("Pow")
+def _pow(im, node):
+    p = float(np.asarray(im.const(node.inputs[1])).ravel()[0])
+    im.env[node.outputs[0]] = ops.power_op(
+        im.materialize(node.inputs[0]), p)
+
+
+@imports("Sum")
+def _sum(im, node):
+    out = im.materialize(node.inputs[0])
+    for name in node.inputs[1:]:
+        out = ops.add_op(out, im.materialize(name))
+    im.env[node.outputs[0]] = out
+
+
+@imports("Gemm")
+def _gemm(im, node):
+    """y = alpha * A' B' + beta * C — torch exports nn.Linear this way
+    (alpha=beta=1, transB=1)."""
+    alpha = float(node.attr("alpha", 1.0))
+    beta = float(node.attr("beta", 1.0))
+    trans_a = bool(node.attr("transA", 0))
+    trans_b = bool(node.attr("transB", 0))
+    y = ops.matmul_op(im.materialize(node.inputs[0]),
+                      im.materialize(node.inputs[1]),
+                      trans_A=trans_a, trans_B=trans_b)
+    if alpha != 1.0:
+        y = ops.mul_byconst_op(y, alpha)
+    if len(node.inputs) > 2:
+        c = im.materialize(node.inputs[2])
+        if beta != 1.0:
+            c = ops.mul_byconst_op(c, beta)
+        y = y + ops.broadcastto_op(c, y)
+    im.env[node.outputs[0]] = y
+
+
+@imports("Flatten")
+def _flatten(im, node):
+    im.env[node.outputs[0]] = ops.flatten_op(
+        im.materialize(node.inputs[0]), int(node.attr("axis", 1)))
+
+
+@imports("Squeeze")
+def _squeeze(im, node):
+    axes = _attr_ints(node, "axes")
+    if not axes and len(node.inputs) > 1:      # opset 13 operand form
+        axes = [int(a) for a in im.const(node.inputs[1])]
+    im.env[node.outputs[0]] = ops.squeeze_op(
+        im.materialize(node.inputs[0]), axes or None)
+
+
+@imports("Unsqueeze")
+def _unsqueeze(im, node):
+    axes = _attr_ints(node, "axes")
+    if not axes and len(node.inputs) > 1:
+        axes = [int(a) for a in im.const(node.inputs[1])]
+    im.env[node.outputs[0]] = ops.unsqueeze_op(
+        im.materialize(node.inputs[0]), axes)
+
+
+# TensorProto dtype code -> numpy (proto.py stores arrays; Cast needs
+# the target code only)
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+           7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+@imports("Cast")
+def _cast(im, node):
+    code = int(node.attr("to", 1))
+    im.env[node.outputs[0]] = ops.cast_op(
+        im.materialize(node.inputs[0]), _DTYPES.get(code, np.float32))
+
+
+@imports("Clip")
+def _clip(im, node):
+    lo = hi = None
+    if node.attr("min") is not None:
+        lo = float(node.attr("min"))
+    elif len(node.inputs) > 1 and node.inputs[1]:
+        lo = float(np.asarray(im.const(node.inputs[1])).ravel()[0])
+    if node.attr("max") is not None:
+        hi = float(node.attr("max"))
+    elif len(node.inputs) > 2 and node.inputs[2]:
+        hi = float(np.asarray(im.const(node.inputs[2])).ravel()[0])
+    im.env[node.outputs[0]] = ops.clip_op(
+        im.materialize(node.inputs[0]), lo, hi)
+
+
+@imports("GlobalAveragePool")
+def _global_avg_pool(im, node):
+    im.env[node.outputs[0]] = ops.reduce_mean_op(
+        im.materialize(node.inputs[0]), [2, 3], keepdims=True)
+
+
+@imports("Where")
+def _where(im, node):
+    im.env[node.outputs[0]] = ops.where_op(
+        im.materialize(node.inputs[0]), im.materialize(node.inputs[1]),
+        im.materialize(node.inputs[2]))
+
+
+@imports("LeakyRelu")
+def _leaky_relu(im, node):
+    im.env[node.outputs[0]] = ops.leaky_relu_op(
+        im.materialize(node.inputs[0]),
+        float(node.attr("alpha", 0.01)))
+
+
+@imports("Gelu")
+def _gelu(im, node):
+    im.env[node.outputs[0]] = ops.gelu_op(im.materialize(node.inputs[0]))
+
+
+@imports("Constant")
+def _constant(im, node):
+    t = node.attr("value")
+    im.consts[node.outputs[0]] = t.array
+
+
+@imports("Split")
+def _split(im, node):
+    axis = int(node.attr("axis", 0))
+    sizes = _attr_ints(node, "split")
+    if not sizes and len(node.inputs) > 1:
+        sizes = [int(s) for s in im.const(node.inputs[1])]
+    x = im.materialize(node.inputs[0])
+    start = 0
+    nparts = len(node.outputs)
+    for k, out_name in enumerate(node.outputs):
+        if sizes:
+            size = sizes[k]
+        else:
+            size = None     # equal split needs the input length
+        if size is None:
+            im.env[out_name] = ops.split_op(x, [axis], [k], [nparts])
+        else:
+            begin = [0] * (axis + 1)
+            begin[axis] = start
+            shape = [-1] * (axis + 1)
+            shape[axis] = size
+            im.env[out_name] = ops.slice_op(x, begin, shape)
+            start += size
 
 
 def load_onnx(path):

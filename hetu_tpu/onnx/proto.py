@@ -232,7 +232,11 @@ class Attribute:
                 a.value = Tensor.parse(value)
                 a.kind = A_TENSOR
             elif field == 7:
-                floats.append(struct.unpack("<f", value)[0])
+                if wire == 2:     # packed (proto3 default for floats)
+                    floats.extend(struct.unpack(
+                        f"<{len(value) // 4}f", value))
+                else:
+                    floats.append(struct.unpack("<f", value)[0])
             elif field == 8:
                 if wire == 2:     # packed
                     pos = 0

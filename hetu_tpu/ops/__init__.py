@@ -3,14 +3,16 @@ from .variable import Variable, placeholder_op, PlaceholderOp
 from .basic import (
     add_op, addbyconst_op, mul_op, mul_byconst_op, div_op, div_const_op,
     div_handle_zero_op, opposite_op, sqrt_op, rsqrt_op, exp_op, log_op,
-    abs_op, power_op, where_op, one_hot_op, matrix_dot_op,
+    abs_op, power_op, where_op, one_hot_op, matrix_dot_op, cast_op,
+    clip_op, clip_mask_op,
 )
 from .shape import (
     array_reshape_op, array_reshape_gradient_op, broadcastto_op,
     broadcast_shape_op, concat_op, concat_gradient_op, concatenate_op,
     split_op, split_gradient_op, slice_op, slice_gradient_op, transpose_op,
     pad_op, pad_gradient_op, unbroadcast_op, reduce_sum_op, reduce_mean_op,
-    reducesumaxiszero_op, oneslike_op, zeroslike_op,
+    reducesumaxiszero_op, oneslike_op, zeroslike_op, flatten_op,
+    squeeze_op, unsqueeze_op,
 )
 from .activations import (
     relu_op, relu_gradient_op, leaky_relu_op, leaky_relu_gradient_op,

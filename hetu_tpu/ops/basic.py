@@ -17,7 +17,8 @@ __all__ = [
     "add_op", "addbyconst_op", "mul_op", "mul_byconst_op", "div_op",
     "div_const_op", "div_handle_zero_op", "opposite_op", "sqrt_op",
     "rsqrt_op", "where_op", "one_hot_op", "matrix_dot_op", "power_op",
-    "exp_op", "log_op", "abs_op", "erf_op",
+    "exp_op", "log_op", "abs_op", "erf_op", "cast_op", "clip_op",
+    "clip_mask_op",
 ]
 
 
@@ -369,6 +370,67 @@ class MatrixDotOp(Op):
         return input_shapes[0]
 
 
+class CastOp(Op):
+    """Dtype cast (ONNX Cast). Gradient passes through (cast back happens
+    implicitly at the consumer's dtype)."""
+
+    def __init__(self, node_A, dtype, ctx=None):
+        super().__init__(CastOp, [node_A], ctx)
+        self.dtype = jnp.dtype(dtype)
+
+    def compute(self, input_vals, ectx):
+        return input_vals[0].astype(self.dtype)
+
+    def gradient(self, output_grad):
+        return [output_grad]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class ClipOp(Op):
+    """Clamp to [min_val, max_val]; gradient is masked to the interior
+    (ONNX Clip)."""
+
+    def __init__(self, node_A, min_val=None, max_val=None, ctx=None):
+        super().__init__(ClipOp, [node_A], ctx)
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def compute(self, input_vals, ectx):
+        return jnp.clip(input_vals[0], self.min_val, self.max_val)
+
+    def gradient(self, output_grad):
+        mask = clip_mask_op(self.inputs[0], self.min_val, self.max_val,
+                            ctx=self.raw_ctx)
+        return [mul_op(output_grad, mask, ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class ClipMaskOp(Op):
+    def __init__(self, node_A, min_val, max_val, ctx=None):
+        super().__init__(ClipMaskOp, [node_A], ctx)
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        mask = jnp.ones_like(x)
+        if self.min_val is not None:
+            mask = mask * (x >= self.min_val)
+        if self.max_val is not None:
+            mask = mask * (x <= self.max_val)
+        return mask
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, input_shapes):
+        return input_shapes[0]
+
+
 # ---------------------------------------------------------------------------
 # builders (reference-named)
 # ---------------------------------------------------------------------------
@@ -443,3 +505,15 @@ def one_hot_op(node, num_classes, ctx=None):
 
 def matrix_dot_op(node_A, node_B, axes=0, ctx=None):
     return MatrixDotOp(node_A, node_B, axes=axes, ctx=ctx)
+
+
+def cast_op(node, dtype, ctx=None):
+    return CastOp(node, dtype, ctx=ctx)
+
+
+def clip_op(node, min_val=None, max_val=None, ctx=None):
+    return ClipOp(node, min_val=min_val, max_val=max_val, ctx=ctx)
+
+
+def clip_mask_op(node, min_val, max_val, ctx=None):
+    return ClipMaskOp(node, min_val, max_val, ctx=ctx)

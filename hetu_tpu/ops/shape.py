@@ -19,6 +19,7 @@ __all__ = [
     "transpose_op", "pad_op", "pad_gradient_op", "unbroadcast_op",
     "reduce_sum_op",
     "reduce_mean_op", "reducesumaxiszero_op", "oneslike_op", "zeroslike_op",
+    "flatten_op", "squeeze_op", "unsqueeze_op",
 ]
 
 
@@ -393,8 +394,12 @@ class SliceOp(Op):
 
     def infer_shape(self, input_shapes):
         in_shape = input_shapes[0]
-        return tuple(in_shape[i] - self.begin_pos[i] if s == -1 else s
-                     for i, s in enumerate(self.output_shape))
+        out = [in_shape[i] - self.begin_pos[i] if s == -1 else s
+               for i, s in enumerate(self.output_shape)]
+        # begin/size may cover only the leading dims (partial indexing,
+        # matching compute's tuple-of-slices): trailing dims pass through
+        out.extend(in_shape[len(self.output_shape):])
+        return tuple(out)
 
 
 class SliceGradientOp(Op):
@@ -709,6 +714,80 @@ class ZerosLikeOp(Op):
         return input_shapes[0]
 
 
+class FlattenOp(Op):
+    """Collapse the dims from ``axis`` on into one (ONNX Flatten; the
+    reference reaches the same layout through Reshape with a computed
+    shape, onnx_opset/Reshape.py)."""
+
+    def __init__(self, node_A, axis=1, ctx=None):
+        super().__init__(FlattenOp, [node_A], ctx)
+        self.axis = int(axis)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        return jnp.reshape(x, x.shape[:self.axis] + (-1,))
+
+    def gradient(self, output_grad):
+        return [array_reshape_gradient_op(output_grad, self,
+                                          ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        s = input_shapes[0]
+        return tuple(s[:self.axis]) + (int(np.prod(s[self.axis:])),)
+
+
+class SqueezeOp(Op):
+    """Drop size-1 dims — the given ``axes``, or all when None."""
+
+    def __init__(self, node_A, axes=None, ctx=None):
+        super().__init__(SqueezeOp, [node_A], ctx)
+        self.axes = None if axes is None else tuple(int(a) for a in axes)
+
+    def _out_shape(self, in_shape):
+        if self.axes is None:
+            return tuple(d for d in in_shape if d != 1)
+        axes = {a % len(in_shape) for a in self.axes}
+        return tuple(d for i, d in enumerate(in_shape) if i not in axes)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        return jnp.reshape(x, self._out_shape(x.shape))
+
+    def gradient(self, output_grad):
+        return [array_reshape_gradient_op(output_grad, self,
+                                          ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return self._out_shape(tuple(input_shapes[0]))
+
+
+class UnsqueezeOp(Op):
+    """Insert size-1 dims at ``axes`` (positions in the output shape)."""
+
+    def __init__(self, node_A, axes, ctx=None):
+        super().__init__(UnsqueezeOp, [node_A], ctx)
+        self.axes = tuple(int(a) for a in axes)
+
+    def _out_shape(self, in_shape):
+        ndim = len(in_shape) + len(self.axes)
+        axes = {a % ndim for a in self.axes}
+        out, it = [], iter(in_shape)
+        for i in range(ndim):
+            out.append(1 if i in axes else next(it))
+        return tuple(out)
+
+    def compute(self, input_vals, ectx):
+        x = input_vals[0]
+        return jnp.reshape(x, self._out_shape(x.shape))
+
+    def gradient(self, output_grad):
+        return [array_reshape_gradient_op(output_grad, self,
+                                          ctx=self.raw_ctx)]
+
+    def infer_shape(self, input_shapes):
+        return self._out_shape(tuple(input_shapes[0]))
+
+
 # ---------------------------------------------------------------------------
 # builders
 # ---------------------------------------------------------------------------
@@ -759,6 +838,18 @@ def split_gradient_op(node, axes, indices, splits, forward_node=None,
                       ctx=None):
     return SplitGradientOp(node, axes, indices, splits,
                            forward_node=forward_node, ctx=ctx)
+
+
+def flatten_op(node, axis=1, ctx=None):
+    return FlattenOp(node, axis=axis, ctx=ctx)
+
+
+def squeeze_op(node, axes=None, ctx=None):
+    return SqueezeOp(node, axes=axes, ctx=ctx)
+
+
+def unsqueeze_op(node, axes, ctx=None):
+    return UnsqueezeOp(node, axes, ctx=ctx)
 
 
 def slice_op(node, begin, size, ctx=None):

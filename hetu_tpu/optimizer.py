@@ -315,13 +315,19 @@ class OptimizerOp(Op):
                 comm = grad
             elif (strategy == "PS" and not param.is_embed
                     and config.device_cache_tables
-                    and config.prefetch and not config.bsp):
+                    and config.prefetch and not config.bsp
+                    and isinstance(self.optimizer, SGDOptimizer)):
                 # unified HET treatment for dense PS params under the
                 # device-cache ASP mode: locally optimizer-updated every
                 # step (never frozen), with raw grads accumulated in HBM
                 # state and drained to the server on the cache cadence —
                 # one protocol for every parameter, zero per-step host
-                # traffic (ps/runtime.py _drain_dense_cached)
+                # traffic (ps/runtime.py _drain_dense_cached).
+                # SGD only: applying the summed raw grads server-side
+                # commutes with the worker's per-step updates, so the
+                # server value (what save() checkpoints) tracks the
+                # worker's weights; stateful optimizers (Adam/Momentum)
+                # would diverge and instead take the per-step PS comm op
                 param.device_cached = True
                 param.stateful = True
                 param.state_shapes = \

@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from ..ndarray import IndexedSlices
 from .device_cache import DeviceCacheTable, pad_fill, pad_gather_zero
@@ -489,44 +490,48 @@ class PSRuntime:
 
         note = []
         for rt, ids_node, slots_node in cached:
+            # one vectorized assignment for the whole block: the scan
+            # threads a single cache array, so the residency set equals
+            # per-step assigns with pins held — see assign_block()
             t0 = time.perf_counter()
-            slot_rows = []
-            for ids in ids_block[ids_node]:
-                slots, miss_ids, miss_slots, uniq_slots = rt.assign(
-                    ids, functools.partial(self._drain_device_table, rt,
-                                           wait=True))
-                self.times["slot_assign"] += time.perf_counter() - t0
+            slots_full, miss_ids, miss_slots, uniq_slots, counts = \
+                rt.assign_block(
+                    np.stack(ids_block[ids_node]),
+                    functools.partial(self._drain_device_table, rt,
+                                      wait=True))
+            self.times["slot_assign"] += time.perf_counter() - t0
+            if len(miss_ids):
                 t0 = time.perf_counter()
-                if len(miss_ids):
-                    fut = rt._drain_future
-                    inflight = getattr(rt, "_inflight_ids", None)
-                    if fut is not None and not fut.done() and \
-                            inflight is not None and \
-                            np.isin(miss_ids, inflight).any():
-                        fut.result()
-                        rt._drain_future = None
-                    rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
+                fut = rt._drain_future
+                inflight = getattr(rt, "_inflight_ids", None)
+                if fut is not None and not fut.done() and \
+                        inflight is not None and \
+                        np.isin(miss_ids, inflight).any():
+                    fut.result()
+                    rt._drain_future = None
+                rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
+                executor.params[rt.cache_sid] = pad_fill(
+                    executor.params[rt.cache_sid], miss_slots, rows,
+                    rt.capacity)
+                self.times["miss_fill"] += time.perf_counter() - t0
+            if rt.nworkers > 1:
+                # bounded-staleness refresh; mid-block refreshes would
+                # collapse to this pre-block fill anyway (the compiled
+                # scan never re-reads the server)
+                t0 = time.perf_counter()
+                uniq_ids = rt.id_of[uniq_slots]
+                fill_slots, fill_rows = rt.stale_check(uniq_ids,
+                                                       uniq_slots)
+                if fill_slots is not None:
                     executor.params[rt.cache_sid] = pad_fill(
-                        executor.params[rt.cache_sid], miss_slots, rows,
-                        rt.capacity)
-                    self.times["miss_fill"] += time.perf_counter() - t0
-                    t0 = time.perf_counter()
-                if rt.nworkers > 1:
-                    # bounded-staleness refresh, same as run_step
-                    uniq_ids = rt.id_of[uniq_slots]
-                    fill_slots, fill_rows = rt.stale_check(uniq_ids,
-                                                           uniq_slots)
-                    if fill_slots is not None:
-                        executor.params[rt.cache_sid] = pad_fill(
-                            executor.params[rt.cache_sid], fill_slots,
-                            fill_rows, rt.capacity)
-                    self.times["refresh"] += time.perf_counter() - t0
-                    t0 = time.perf_counter()
-                slot_rows.append(slots)
-                if sub.training:
-                    note.append((rt, uniq_slots))
-            feed_map[slots_node] = sub._ingest_stacked(np.stack(slot_rows))
-            first_map[slots_node] = slot_rows[0]
+                        executor.params[rt.cache_sid], fill_slots,
+                        fill_rows, rt.capacity)
+                self.times["refresh"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            feed_map[slots_node] = sub._ingest_stacked(slots_full)
+            first_map[slots_node] = slots_full[0]
+            if sub.training:
+                note.append((rt, uniq_slots, counts))
             self.times["slot_assign"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -535,8 +540,8 @@ class PSRuntime:
         self.times["dispatch"] += time.perf_counter() - t0
 
         stepped_tables = set()
-        for rt, uniq_slots in note:
-            rt.note_update(uniq_slots)
+        for rt, uniq_slots, counts in note:
+            rt.note_update(uniq_slots, counts)
             stepped_tables.add(rt)
         for rt, _, _ in cached:
             rt.release_pins()
@@ -732,3 +737,19 @@ class PSRuntime:
         # cached rows predate the load — invalidate so lookups refill
         for rt in self.device_tables.values():
             rt.invalidate()
+        # dense HET params keep a worker-local copy in executor.params
+        # that single-worker runs never pull back: refresh it from the
+        # server so load() is not a silent no-op (ADVICE r3), and zero
+        # the pre-load grad accumulators the checkpoint supersedes
+        executor = self.executor
+        for param, _opt in self.config.ps_dense_cached:
+            sid = str(param.id)
+            value = self.client.pull(
+                param.id, (int(np.prod(param.shape)),))
+            if sid in executor.params:
+                executor.params[sid] = jax.device_put(
+                    np.asarray(value).reshape(param.shape))
+            st = executor.state.get(sid)
+            if st is not None:
+                executor.state[sid] = {
+                    "acc": jnp.zeros_like(st["acc"])}

@@ -1,0 +1,58 @@
+"""Generate the golden external-interop artifacts: a small CNN exported
+by STOCK torch.onnx (not this repo's exporter) plus its input/output
+pair.  Run from the repo root:
+
+    python tests/data/gen_torch_onnx.py
+
+The environment lacks the ``onnx`` pip package; torch builds the
+ModelProto bytes in C++ and only needs ``onnx`` for a post-pass that
+scans for onnxscript custom functions — which plain models don't have —
+so that pass is stubbed to identity here.
+"""
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(1, 4, 3, padding=1)
+        self.fc1 = nn.Linear(4 * 14 * 14, 32)
+        self.fc2 = nn.Linear(32, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.conv(x))
+        x = torch.max_pool2d(x, 2)
+        x = x.flatten(1)
+        x = torch.nn.functional.leaky_relu(self.fc1(x), 0.1)
+        x = torch.clamp(x, -1.0, 1.0)
+        return torch.sigmoid(self.fc2(x))
+
+
+def export(path_onnx, path_npz):
+    from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda b, opsets: b
+    try:
+        torch.manual_seed(0)
+        net = Net().eval()
+        x = torch.randn(2, 1, 28, 28)
+        with torch.no_grad():
+            y = net(x)
+        torch.onnx.export(net, x, path_onnx, opset_version=13,
+                          input_names=["x"], output_names=["y"],
+                          dynamo=False)
+        np.savez(path_npz, x=x.numpy(), y=y.numpy())
+        print(f"wrote {path_onnx} ({os.path.getsize(path_onnx)} bytes) "
+              f"and {path_npz}")
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    export(os.path.join(here, "torch_cnn.onnx"),
+           os.path.join(here, "torch_cnn_io.npz"))

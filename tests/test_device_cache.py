@@ -195,6 +195,75 @@ def test_unified_dense_het_matches_local(ps_env):
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
+def test_dense_het_restricted_to_sgd(ps_env):
+    """Stateful optimizers (Adam) must NOT take the unified dense HET
+    path: one server apply over summed grads does not commute with the
+    worker's per-step updates, so save() would checkpoint diverged
+    values (ADVICE r3). They fall back to the per-step PS comm op."""
+    rng = np.random.RandomState(11)
+    table = rng.randn(40, 4).astype(np.float32)
+    w_val = rng.randn(4, 2).astype(np.float32) * 0.1
+
+    ids = ht.Variable("s_ids", trainable=False)
+    y_ = ht.Variable("s_y", trainable=False)
+    tbl = ht.Variable("s_table", value=table)
+    w = ht.Variable("s_w", value=w_val)
+    rows = ht.embedding_lookup_op(tbl, ids)
+    pred = ht.matmul_op(ht.reduce_sum_op(rows, [1]), w)
+    diff = pred + (-1) * y_
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(diff * diff, [1]), [0])
+    train = ht.optim.AdamOptimizer(0.01).minimize(loss)
+
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=4)
+    assert not exe.config.ps_dense_cached
+    assert not getattr(w, "device_cached", False)
+    batch = (rng.randint(0, 40, (8, 3)),
+             rng.randn(8, 2).astype(np.float32))
+    losses = _run_steps(exe, ids, y_, [batch] * 6)
+    assert losses[-1] < losses[0]
+    exe.close()
+
+
+def test_dense_het_load_refreshes_worker(ps_env, tmp_path):
+    """load() must refresh the worker-local copies of dense HET params
+    from the server — single-worker runs never pull back otherwise, and
+    load() would be a silent no-op for them (ADVICE r3)."""
+    rng = np.random.RandomState(12)
+    table = rng.randn(40, 4).astype(np.float32)
+    w_val = rng.randn(4, 2).astype(np.float32) * 0.1
+
+    def build():
+        ids = ht.Variable("l_ids", trainable=False)
+        y_ = ht.Variable("l_y", trainable=False)
+        tbl = ht.Variable("l_table", value=table)
+        w = ht.Variable("l_w", value=w_val)
+        rows = ht.embedding_lookup_op(tbl, ids)
+        pred = ht.matmul_op(ht.reduce_sum_op(rows, [1]), w)
+        diff = pred + (-1) * y_
+        loss = ht.reduce_mean_op(ht.reduce_sum_op(diff * diff, [1]), [0])
+        train = ht.optim.SGDOptimizer(0.05).minimize(loss)
+        return ids, y_, w, loss, train
+
+    batches = [(rng.randint(0, 40, (8, 3)),
+                rng.randn(8, 2).astype(np.float32)) for _ in range(10)]
+
+    ids, y_, w, loss, train = build()
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=3)
+    assert exe.config.ps_dense_cached, "w should take the dense HET path"
+    _run_steps(exe, ids, y_, batches[:5])
+    exe.save(str(tmp_path))
+    saved_w = np.asarray(exe.params[str(w.id)]).copy()
+    _run_steps(exe, ids, y_, batches[5:])       # worker diverges
+    assert not np.allclose(np.asarray(exe.params[str(w.id)]), saved_w,
+                           rtol=1e-5)
+    exe.load(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(exe.params[str(w.id)]),
+                               saved_w, rtol=1e-4)
+    exe.close()
+
+
 def test_device_cache_save_load(ps_env, tmp_path):
     rng = np.random.RandomState(5)
     table = rng.randn(30, 4).astype(np.float32)
