@@ -75,12 +75,16 @@ def worker(args):
     # tunnel serializes num_users round trips — batching users changes
     # nothing numerically (the model is pointwise over [B] ids)
     eval_batch = 100 * args.eval_users
+    # drop_last=False: every user gets scored (the tail batch stays a
+    # multiple of 100 because the total and eval_batch both are)
     user_input = ht.dataloader_op([
         ht.Dataloader(train_users, batch, "train"),
-        ht.Dataloader(test_user_input, eval_batch, "validate")])
+        ht.Dataloader(test_user_input, eval_batch, "validate",
+                      drop_last=False)])
     item_input = ht.dataloader_op([
         ht.Dataloader(train_items, batch, "train"),
-        ht.Dataloader(test_item_input, eval_batch, "validate")])
+        ht.Dataloader(test_item_input, eval_batch, "validate",
+                      drop_last=False)])
     y_ = ht.dataloader_op([
         ht.Dataloader(train_labels, batch, "train")])
 
@@ -133,7 +137,10 @@ def worker(args):
                 k = min(kblock, nbatch - done)
                 out = executor.run_batches([{}] * k, name="train")
                 done += k
-            train_loss.append(float(np.mean(out[-1][0].asnumpy())))
+                # first asnumpy syncs the block; the rest read slices of
+                # the already-materialized stacked output
+                train_loss.extend(
+                    float(np.mean(o[0].asnumpy())) for o in out)
         ep_time = time.time() - ep_st
         msg = f"epoch {ep}: train_loss {np.mean(train_loss):.4f}"
         if args.val:

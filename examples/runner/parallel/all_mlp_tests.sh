@@ -1,0 +1,32 @@
+#!/bin/bash
+# The zoo's parity sweep (reference parity: all_mlp_tests.sh): the base
+# run is ground truth; every parallel config must reproduce its loss
+# series. Hermetic form — 8 virtual CPU devices; drop the two exports
+# to run on real TPU chips.
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+HETURUN=../../../bin/heturun
+mkdir -p results
+
+$HETURUN -c config1.yml python test_mlp_base.py --save --log results/base.npy
+
+$HETURUN -c config2.yml python test_mlp_pp.py --log results/res0.npy
+
+$HETURUN -c config2.yml python test_mlp_mp.py --split left   --log results/res1.npy
+$HETURUN -c config2.yml python test_mlp_mp.py --split middle --log results/res2.npy
+$HETURUN -c config2.yml python test_mlp_mp.py --split right  --log results/res3.npy
+$HETURUN -c config4.yml python test_mlp_mp.py --split 0      --log results/res4.npy
+$HETURUN -c config4.yml python test_mlp_mp.py --split 1      --log results/res5.npy
+$HETURUN -c config4.yml python test_mlp_mp.py --split 2      --log results/res6.npy
+$HETURUN -c config4.yml python test_mlp_mp.py --split 3      --log results/res7.npy
+$HETURUN -c config4.yml python test_mlp_mp.py --split 4      --log results/res8.npy
+
+$HETURUN -c config4.yml python test_mlp_mp_pp.py --split left   --log results/res9.npy
+$HETURUN -c config4.yml python test_mlp_mp_pp.py --split middle --log results/res10.npy
+$HETURUN -c config4.yml python test_mlp_mp_pp.py --split right  --log results/res11.npy
+$HETURUN -c config8.yml python test_mlp_mp_pp.py --split 1      --log results/res12.npy
+
+python validate_results.py 13
+echo "all parallel configs match the base loss series"

@@ -262,9 +262,15 @@ def _sub(im, node):
 
 @imports("Pow")
 def _pow(im, node):
-    p = float(np.asarray(im.const(node.inputs[1])).ravel()[0])
+    if node.inputs[1] not in im.consts:
+        raise NotImplementedError(
+            "Pow requires a constant exponent initializer")
+    p = np.asarray(im.const(node.inputs[1]))
+    if p.size != 1:
+        raise NotImplementedError(
+            "Pow supports scalar exponents only")
     im.env[node.outputs[0]] = ops.power_op(
-        im.materialize(node.inputs[0]), p)
+        im.materialize(node.inputs[0]), float(p.ravel()[0]))
 
 
 @imports("Sum")
@@ -320,17 +326,27 @@ def _unsqueeze(im, node):
         im.materialize(node.inputs[0]), axes)
 
 
-# TensorProto dtype code -> numpy (proto.py stores arrays; Cast needs
+# TensorProto dtype code -> dtype (proto.py stores arrays; Cast needs
 # the target code only)
-_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
-           7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+def _onnx_dtype(code):
+    if code == 16:
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    table = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+             5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+             10: np.float16, 11: np.float64, 12: np.uint32,
+             13: np.uint64}
+    if code not in table:
+        raise NotImplementedError(
+            f"Cast to TensorProto dtype code {code} not supported")
+    return table[code]
 
 
 @imports("Cast")
 def _cast(im, node):
     code = int(node.attr("to", 1))
     im.env[node.outputs[0]] = ops.cast_op(
-        im.materialize(node.inputs[0]), _DTYPES.get(code, np.float32))
+        im.materialize(node.inputs[0]), _onnx_dtype(code))
 
 
 @imports("Clip")

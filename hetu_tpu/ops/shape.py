@@ -715,9 +715,10 @@ class ZerosLikeOp(Op):
 
 
 class FlattenOp(Op):
-    """Collapse the dims from ``axis`` on into one (ONNX Flatten; the
-    reference reaches the same layout through Reshape with a computed
-    shape, onnx_opset/Reshape.py)."""
+    """ONNX Flatten: always 2-D output ``(prod(dims[:axis]),
+    prod(dims[axis:]))`` — axis=0 gives ``(1, total)`` (the reference
+    reaches the same layout through Reshape with a computed shape,
+    onnx_opset/Reshape.py)."""
 
     def __init__(self, node_A, axis=1, ctx=None):
         super().__init__(FlattenOp, [node_A], ctx)
@@ -725,7 +726,8 @@ class FlattenOp(Op):
 
     def compute(self, input_vals, ectx):
         x = input_vals[0]
-        return jnp.reshape(x, x.shape[:self.axis] + (-1,))
+        lead = int(np.prod(x.shape[:self.axis]))
+        return jnp.reshape(x, (lead, -1))
 
     def gradient(self, output_grad):
         return [array_reshape_gradient_op(output_grad, self,
@@ -733,7 +735,7 @@ class FlattenOp(Op):
 
     def infer_shape(self, input_shapes):
         s = input_shapes[0]
-        return tuple(s[:self.axis]) + (int(np.prod(s[self.axis:])),)
+        return (int(np.prod(s[:self.axis])), int(np.prod(s[self.axis:])))
 
 
 class SqueezeOp(Op):
