@@ -42,9 +42,10 @@ def device(i):
     return ht.cpu(i)
 
 
-def ensure_std():
+def ensure_std(force=False):
     """Write the fixed weights every config loads (the reference keeps a
-    pre-generated std/ dir; we generate deterministically on first use)."""
+    pre-generated std/ dir; we generate deterministically on first use —
+    ``force`` regenerates after a DIMS/init edit)."""
     os.makedirs(STD, exist_ok=True)
     rng = np.random.RandomState(42)
     specs = {
@@ -56,7 +57,7 @@ def ensure_std():
     }
     for name, shape in specs.items():
         path = os.path.join(STD, name + ".npy")
-        if not os.path.exists(path):
+        if force or not os.path.exists(path):
             np.save(path, (rng.randn(*shape) * 0.05).astype(np.float32))
 
 

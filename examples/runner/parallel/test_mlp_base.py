@@ -11,7 +11,7 @@ import hetu_tpu as ht
 
 
 def main(args):
-    common.ensure_std()
+    common.ensure_std(force=args.save)
     with ht.context(common.device(0)):
         x = ht.Variable("dataloader_x", trainable=False)
         act = common.fc(x, "mlp_fc1", with_relu=True)

@@ -19,7 +19,9 @@ import hetu_tpu as ht
 def main(args):
     common.ensure_std()
     act_parts, w_parts = common.SPLITS[args.split]
-    ndev = max(p1 * p2 for p1, p2 in (act_parts, w_parts))
+    # device count = batch-rows x contraction x weight-cols (the three
+    # parallel axes of y = a @ w; max() would undercount composite '2')
+    ndev = act_parts[0] * act_parts[1] * w_parts[1]
     devices = tuple(common.device(i) for i in range(ndev))
 
     with ht.context(common.device(0)):

@@ -1,0 +1,44 @@
+"""MLP-on-MNIST trainer for heturun configs (reference parity:
+examples/runner/run_mlp.py — the runner family's dense workload; comm
+mode comes from the launcher env / --comm-mode, not the script).
+
+    python examples/runner/run_mlp.py --timing --validate
+    bin/heturun -c examples/runner/local_ps.yml \
+        python examples/runner/run_mlp.py --comm-mode PS --timing
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "cnn"))
+import main as cnn_main                              # noqa: E402
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--learning-rate", type=float, default=0.1)
+    parser.add_argument("--opt", default="sgd",
+                        choices=["sgd", "momentum", "nesterov", "adagrad",
+                                 "adam"])
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--validate", action="store_true")
+    parser.add_argument("--timing", action="store_true")
+    parser.add_argument("--comm-mode", default=None)
+    return parser.parse_args(argv)
+
+
+if __name__ == "__main__":
+    a = parse_args()
+    argv = ["--model", "mlp", "--dataset", "MNIST",
+            "--batch-size", str(a.batch_size),
+            "--learning-rate", str(a.learning_rate), "--opt", a.opt,
+            "--num-epochs", str(a.num_epochs)]
+    if a.validate:
+        argv.append("--validate")
+    if a.timing:
+        argv.append("--timing")
+    if a.comm_mode:
+        argv += ["--comm-mode", a.comm_mode]
+    cnn_main.run(cnn_main.parse_args(argv))

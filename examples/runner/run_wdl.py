@@ -1,0 +1,48 @@
+"""Wide&Deep-on-Criteo trainer for heturun configs (reference parity:
+examples/runner/run_wdl.py — the runner family's sparse/PS workload).
+
+    bin/heturun -c examples/runner/local_ps.yml \
+        python examples/runner/run_wdl.py --comm-mode PS --timing
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "ctr"))
+import run_hetu as ctr_main                          # noqa: E402
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--learning-rate", type=float, default=0.01)
+    parser.add_argument("--nepoch", type=int, default=3)
+    parser.add_argument("--val", action="store_true")
+    parser.add_argument("--timing", action="store_true")
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument("--comm-mode", default=None,
+                        help="None / PS / Hybrid")
+    parser.add_argument("--cache", default="Device")
+    parser.add_argument("--bound", type=int, default=100)
+    parser.add_argument("--bsp", action="store_true")
+    return parser.parse_args(argv)
+
+
+if __name__ == "__main__":
+    a = parse_args()
+    argv = ["--model", "wdl_criteo", "--batch-size", str(a.batch_size),
+            "--learning-rate", str(a.learning_rate),
+            "--nepoch", str(a.nepoch), "--cache", a.cache,
+            "--bound", str(a.bound)]
+    if a.val:
+        argv.append("--val")
+    if a.timing:
+        argv.append("--timing")
+    if a.all:
+        argv.append("--all")
+    if a.bsp:
+        argv.append("--bsp")
+    if a.comm_mode:
+        argv += ["--comm-mode", a.comm_mode]
+    ctr_main.worker(ctr_main.parse_args(argv))

@@ -96,7 +96,7 @@ class HetuConfig:
                  prefetch=True, enable_lazy=False, cache_bound=100,
                  cache_capacity=None, log_path=None, gpipe=False,
                  pipedream=False, dynamic_memory=False, mesh=None,
-                 dtype=None, num_microbatches=None):
+                 dtype=None, num_microbatches=None, drain_compress=False):
         maybe_init_distributed()
         self.eval_node_list = eval_node_list
         self.train_name = train_name
@@ -110,6 +110,9 @@ class HetuConfig:
         self.enable_lazy = enable_lazy
         self.cache_bound = cache_bound
         self.cache_capacity = cache_capacity
+        # bf16 HET drains (halve the drain D2H bytes; see
+        # ps/device_cache.py pad_gather_zero)
+        self.drain_compress = drain_compress
         self.log_path = log_path
         self.use_gpipe = gpipe
         self.use_pipedream = pipedream

@@ -164,6 +164,9 @@ class PSClient:
         return int(self.lib.GetLoads())
 
     def shutdown_servers(self):
+        # late drains must fail fast, not burn the reconnect/retry
+        # budget against servers we just stopped (PSRuntime.drain checks)
+        self.servers_down = True
         self.lib.ShutdownServers()
 
     def close(self):

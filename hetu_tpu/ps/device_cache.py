@@ -47,6 +47,13 @@ def _gather_rows(arr, slots):
     return arr[slots]
 
 
+@jax.jit
+def _gather_rows_bf16(arr, slots):
+    # drain compression: the gradient sums leave HBM as bf16 (half the
+    # D2H bytes; the remote-tunnel D2H link is the drain's bottleneck)
+    return arr[slots].astype(jnp.bfloat16)
+
+
 @functools.partial(jax.jit, donate_argnums=0)
 def _zero_rows(arr, slots):
     return arr.at[slots].set(0.0)
@@ -71,7 +78,8 @@ class DeviceCacheTable:
     """
 
     def __init__(self, table_node, cache_node, client, *, capacity, width,
-                 rows, push_bound=100, pull_bound=100, nworkers=1):
+                 rows, push_bound=100, pull_bound=100, nworkers=1,
+                 drain_compress=False):
         self.table_node = table_node
         self.cache_node = cache_node
         self.cache_sid = str(cache_node.id)
@@ -83,6 +91,7 @@ class DeviceCacheTable:
         self.push_bound = int(push_bound)
         self.pull_bound = int(pull_bound)
         self.nworkers = int(nworkers)
+        self.drain_compress = bool(drain_compress)
 
         # id -> slot map: direct-indexed for tables that fit, dict above
         # (a 33.7M-row Criteo map is a 135MB int32 array; a trillion-row
@@ -352,14 +361,27 @@ def pad_fill(cache, slots, rows, scratch_slot):
     return _fill_rows(cache, pslots, prows)
 
 
-def pad_gather_zero(acc, slots, scratch_slot):
+def pad_gather_zero(acc, slots, scratch_slot, compress=False):
     """Gather accumulator rows at ``slots`` then zero them, padded to a
-    bucket. Returns (new_acc, gathered_rows_device, n_real)."""
+    bucket. Returns (new_acc, gathered_rows_device, n_real).
+
+    ``compress=True`` casts the gathered grad sums to bf16 on device —
+    the drain's device->host transfer is the HET path's dominant link
+    cost (notably over a remote TPU tunnel), and the server applies SGD
+    at f32 after widening, so the worker's own full-precision cache is
+    untouched."""
     n = len(slots)
     b = _pad_pow2(n)
     pslots = np.full(b, scratch_slot, np.int64)
     pslots[:n] = slots
     pslots_dev = jnp.asarray(pslots)
-    rows = _gather_rows(acc, pslots_dev)
+    gather = _gather_rows_bf16 if compress else _gather_rows
+    rows = gather(acc, pslots_dev)
     new_acc = _zero_rows(acc, pslots_dev)
+    # transfer only the claimed rows, padded to a coarse chunk (a pow2
+    # pad can double the D2H bytes; a 2048-row chunk wastes <1 chunk
+    # while keeping the slice's jit cache small)
+    m = min(b, -(-n // 2048) * 2048)
+    if m < b:
+        rows = rows[:m]
     return new_acc, rows, n

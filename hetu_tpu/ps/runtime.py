@@ -201,7 +201,8 @@ class PSRuntime:
             capacity=entry["capacity"], width=entry["width"],
             rows=entry["rows"], push_bound=push_bound,
             pull_bound=self.config.cache_bound,
-            nworkers=max(1, self.client.nworkers))
+            nworkers=max(1, self.client.nworkers),
+            drain_compress=getattr(self.config, "drain_compress", False))
         rt._drain_future = None
         self.device_tables[tbl.id] = rt
         self.registered.add(tbl.id)
@@ -577,14 +578,17 @@ class PSRuntime:
             return
         executor = self.executor
         state = executor.state[rt.cache_sid]
-        new_acc, rows_dev, n = pad_gather_zero(state["acc"], slots,
-                                               rt.capacity)
+        new_acc, rows_dev, n = pad_gather_zero(
+            state["acc"], slots, rt.capacity,
+            compress=rt.drain_compress)
         executor.state[rt.cache_sid] = {"acc": new_acc}
         rt.pushed_rows += n
         rt._inflight_ids = ids
 
         def push():
             rows = np.asarray(jax.device_get(rows_dev))[:n]
+            if rows.dtype != np.float32:
+                rows = rows.astype(np.float32)    # widen bf16 drains
             if rt.nworkers > 1:
                 rows = rows / rt.nworkers
             self.client.push_embedding(rt.tid, ids, rows, upds, rt.width)
@@ -673,6 +677,14 @@ class PSRuntime:
     def drain(self):
         """Block until every in-flight push (sparse ASP pushes, device-
         cache drains, dense ASP cycles) has reached the server."""
+        if getattr(self.client, "servers_down", False):
+            # the fleet was stopped under us (bench/test teardown
+            # ordering): pending updates have nowhere to go — dropping
+            # them beats minutes of doomed reconnect retries
+            import sys
+            print("[hetu-ps] drain skipped: servers already shut down",
+                  file=sys.stderr)
+            return
         for rt in self.device_tables.values():
             self._drain_device_table(rt, wait=True)
         if self.config.ps_dense_cached:

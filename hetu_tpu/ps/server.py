@@ -15,6 +15,7 @@ import sys
 import time
 
 _server_procs = []
+_atexit_registered = False
 
 
 def default_port():
@@ -49,8 +50,18 @@ def ensure_server(port=None, nworkers=None, wait_s=10.0):
     proc = subprocess.Popen(
         [sys.executable, "-m", "hetu_tpu.ps.run_server", str(port),
          str(nworkers)],
-        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pypath})
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": pypath},
+        # a fresh fd table: the child must not hold the parent's stdio
+        # pipes open past the parent's death (a `script | tail` would
+        # otherwise never see EOF while the server lives)
+        stdin=subprocess.DEVNULL)
     _server_procs.append(proc)
+    if not _atexit_registered:
+        # single-process convenience runs (examples' ensure_local_ps)
+        # must not leak the fleet past interpreter exit
+        import atexit
+        atexit.register(shutdown_server)
+        globals()["_atexit_registered"] = True
     deadline = time.time() + wait_s
     while time.time() < deadline:
         if _port_open("127.0.0.1", port):

@@ -195,6 +195,34 @@ def test_unified_dense_het_matches_local(ps_env):
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
+def test_drain_compress_converges(ps_env):
+    """bf16-compressed drains (drain_compress=True): training is
+    unchanged on the worker (its cache stays f32); the server copy
+    matches to bf16 precision after drain."""
+    rng = np.random.RandomState(21)
+    table = rng.randn(30, 4).astype(np.float32)
+    batches = _make_batches(rng, steps=7, rows=30)
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=100, drain_compress=True)
+    got = _run_steps(exe, ids, y_, batches)
+    exe.ps_runtime.drain()
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    cache = np.asarray(exe.params[rt.cache_sid])
+    touched = np.nonzero(rt.id_of >= 0)[0]
+    server_rows = ps_env.sparse_pull(rt.tid, rt.id_of[touched], rt.width)
+    np.testing.assert_allclose(server_rows, cache[touched], rtol=2e-2,
+                               atol=2e-2)
+    exe.close()
+
+    # worker-side training is bit-identical to the uncompressed path
+    ids2, y2, loss2, train2 = _embed_model(table)
+    ref_exe = Executor([loss2, train2], comm_mode=None)
+    want = _run_steps(ref_exe, ids2, y2, batches)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 def test_dense_het_restricted_to_sgd(ps_env):
     """Stateful optimizers (Adam) must NOT take the unified dense HET
     path: one server apply over summed grads does not commute with the

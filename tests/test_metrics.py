@@ -1,0 +1,91 @@
+"""Metrics (reference parity: python/hetu/metrics.py) — the thresholded
+confusion series, ROC/PR AUC, one-hot P/R/F averaging, and the streaming
+accumulator, validated against brute force / the exact rank statistic."""
+import numpy as np
+
+from hetu_tpu import metrics as m
+
+
+def _scores(n=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    s = rng.rand(n)
+    y = (rng.rand(n) < s).astype(int)
+    return s, y
+
+
+def test_confusion_matrix_at_thresholds_matches_bruteforce():
+    s, y = _scores(500)
+    thr = [0.1, 0.25, 0.5, 0.9]
+    got = m.confusion_matrix_at_thresholds(s, y, thr)
+    for i, t in enumerate(thr):
+        pred = s > t
+        assert got["tp"][i] == np.sum(pred & (y == 1))
+        assert got["fp"][i] == np.sum(pred & (y == 0))
+        assert got["fn"][i] == np.sum(~pred & (y == 1))
+        assert got["tn"][i] == np.sum(~pred & (y == 0))
+
+
+def test_confusion_includes_filter():
+    s, y = _scores(100)
+    got = m.confusion_matrix_at_thresholds(s, y, [0.5], includes=("tp",))
+    assert set(got) == {"tp"}
+    try:
+        m.confusion_matrix_at_thresholds(s, y, [0.5], includes=("xx",))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_roc_auc_riemann_matches_rank_statistic():
+    s, y = _scores()
+    assert abs(m.auc_at_thresholds(s, y, 400) - m.auc(s, y)) < 0.01
+
+
+def test_pr_auc_reasonable():
+    s, y = _scores()
+    pr = m.auc_at_thresholds(s, y, 400, curve="PR")
+    roc = m.auc_at_thresholds(s, y, 400)
+    assert 0.5 < pr <= 1.0 and 0.5 < roc <= 1.0
+
+
+def test_streaming_auc_matches_batch():
+    s, y = _scores()
+    acc = m.StreamingAUC(400)
+    for i in range(0, len(s), 250):
+        acc.update(s[i:i + 250], y[i:i + 250])
+    assert abs(acc.result() - m.auc_at_thresholds(s, y, 400)) < 1e-12
+    acc.reset()
+    acc.update(s, y)
+    assert abs(acc.result() - m.auc_at_thresholds(s, y, 400)) < 1e-12
+
+
+def test_one_hot_prf_matches_manual():
+    rng = np.random.RandomState(1)
+    y = np.eye(4)[rng.randint(0, 4, 600)]
+    p = rng.rand(600, 4)
+    t = y.argmax(1)
+    pred = p.argmax(1)
+    eps = 1e-6
+    for c in range(4):
+        tp = np.sum((pred == c) & (t == c))
+        fp = np.sum((pred == c) & (t != c))
+        fn = np.sum((pred != c) & (t == c))
+        np.testing.assert_allclose(
+            m.precision_score(p, y)[c], (tp + eps) / (tp + fp + eps))
+        np.testing.assert_allclose(
+            m.recall_score(p, y)[c], (tp + eps) / (tp + fn + eps))
+    micro_p = m.precision_score(p, y, average="micro")
+    macro_p = m.precision_score(p, y, average="macro")
+    np.testing.assert_allclose(micro_p, np.mean(pred == t), atol=1e-5)
+    np.testing.assert_allclose(
+        macro_p, np.mean(m.precision_score(p, y)))
+    f_macro = m.f_score(p, y, average="macro")
+    per_class_f = m.f_score(p, y)
+    np.testing.assert_allclose(f_macro, np.mean(per_class_f))
+
+
+def test_softmax_rows_sum_to_one():
+    z = np.random.RandomState(2).randn(32, 7) * 10
+    p = m.softmax(z)
+    np.testing.assert_allclose(p.sum(1), np.ones(32), atol=1e-12)
+    assert (p >= 0).all()

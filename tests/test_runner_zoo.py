@@ -16,7 +16,7 @@ ZOO = os.path.join(ROOT, "examples", "runner", "parallel")
 HETURUN = os.path.join(ROOT, "bin", "heturun")
 
 
-def _run(tmp, config, script, *extra):
+def _run(config, script, *extra):
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
@@ -27,17 +27,23 @@ def _run(tmp, config, script, *extra):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+@pytest.fixture(scope="module")
+def base_losses(tmp_path_factory):
+    """Ground truth, computed once for every parametrized case."""
+    base = str(tmp_path_factory.mktemp("zoo") / "base.npy")
+    _run("config1.yml", "test_mlp_base.py", "--save", "--log", base)
+    return np.load(base)
+
+
 @pytest.mark.parametrize("case", [
     ("test_mlp_mp.py", ["--split", "middle"]),
+    ("test_mlp_mp.py", ["--split", "2"]),
     ("test_mlp_pp.py", []),
     ("test_mlp_mp_pp.py", ["--split", "left"]),
 ])
-def test_zoo_config_matches_base(tmp_path, case):
+def test_zoo_config_matches_base(tmp_path, base_losses, case):
     script, extra = case
-    base = str(tmp_path / "base.npy")
     res = str(tmp_path / "res0.npy")
-    _run(tmp_path, "config1.yml", "test_mlp_base.py", "--save",
-         "--log", base)
-    _run(tmp_path, "config4.yml", script, *extra, "--log", res)
-    np.testing.assert_allclose(np.load(base), np.load(res), rtol=1e-4,
+    _run("config4.yml", script, *extra, "--log", res)
+    np.testing.assert_allclose(base_losses, np.load(res), rtol=1e-4,
                                atol=1e-6)
