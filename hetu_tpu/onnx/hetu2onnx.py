@@ -13,7 +13,8 @@ import numpy as np
 from ..graph.autodiff import find_topo_sort
 from ..ops.variable import PlaceholderOp
 from . import proto
-from .proto import Attribute, Graph, Model, Node, Tensor, ValueInfo
+from .proto import (Attribute, DTYPE_CODES, Graph, Model, Node, Tensor,
+                    ValueInfo)
 
 __all__ = ["export"]
 
@@ -87,8 +88,14 @@ class _Exporter:
             handler(self, node)
         for out in self.outputs:
             shape = tuple(getattr(out, "inferred_shape", None) or ())
+            # a CastOp output's declared dtype must match its target
+            # (external runtimes type-check the graph outputs)
+            dt = proto.TENSOR_FLOAT
+            if type(out).__name__ == "CastOp":
+                dt = DTYPE_CODES.get(np.dtype(out.dtype).name,
+                                     proto.TENSOR_FLOAT)
             self.graph.outputs.append(
-                ValueInfo(self.name(out), proto.TENSOR_FLOAT, shape))
+                ValueInfo(self.name(out), dt, shape))
         return self.graph
 
 
@@ -121,8 +128,59 @@ for hetu_name, onnx_name in [
         ("OppositeOp", "Neg"), ("SqrtOp", "Sqrt"), ("ReluOp", "Relu"),
         ("SigmoidOp", "Sigmoid"), ("TanhOp", "Tanh"),
         ("WhereOp", "Where"), ("ExpOp", "Exp"), ("LogOp", "Log"),
-        ("AbsOp", "Abs")]:
+        ("AbsOp", "Abs"), ("ErfOp", "Erf")]:
     _HANDLERS[hetu_name] = _simple(onnx_name)
+
+
+@handles("FlattenOp")
+def _flatten(ex, node):
+    ex.add("Flatten", [_in(ex, node)], [ex.name(node)],
+           axis=int(node.axis))
+
+
+@handles("SqueezeOp")
+def _squeeze(ex, node):
+    # attribute form: this exporter declares opset 11 (the operand form
+    # is opset 13+); the importer accepts both
+    attrs = {} if node.axes is None else {"axes": list(node.axes)}
+    ex.add("Squeeze", [_in(ex, node)], [ex.name(node)], **attrs)
+
+
+@handles("UnsqueezeOp")
+def _unsqueeze(ex, node):
+    ex.add("Unsqueeze", [_in(ex, node)], [ex.name(node)],
+           axes=list(node.axes))
+
+
+@handles("CastOp")
+def _cast(ex, node):
+    ex.add("Cast", [_in(ex, node)], [ex.name(node)],
+           to=DTYPE_CODES[np.dtype(node.dtype).name])
+
+
+@handles("ClipOp")
+def _clip(ex, node):
+    inputs = [_in(ex, node)]
+    if node.min_val is not None or node.max_val is not None:
+        inputs.append(ex.const(
+            np.asarray(-np.inf if node.min_val is None
+                       else node.min_val, np.float32), "min"))
+    if node.max_val is not None:
+        inputs.append(ex.const(np.asarray(node.max_val, np.float32),
+                               "max"))
+    ex.add("Clip", inputs, [ex.name(node)])
+
+
+@handles("LeakyReluOp")
+def _leaky_relu(ex, node):
+    ex.add("LeakyRelu", [_in(ex, node)], [ex.name(node)],
+           alpha=float(node.alpha))
+
+
+@handles("PowerOp")
+def _power(ex, node):
+    p = ex.const(np.asarray(node.p, np.float32), "exponent")
+    ex.add("Pow", [_in(ex, node), p], [ex.name(node)])
 
 
 @handles("AddByConstOp")

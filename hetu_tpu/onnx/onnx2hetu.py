@@ -326,20 +326,18 @@ def _unsqueeze(im, node):
         im.materialize(node.inputs[0]), axes)
 
 
-# TensorProto dtype code -> dtype (proto.py stores arrays; Cast needs
-# the target code only)
+# TensorProto dtype code -> dtype, inverted from proto.DTYPE_CODES (the
+# single source of truth shared with the exporter's Cast handler)
 def _onnx_dtype(code):
-    if code == 16:
-        import jax.numpy as jnp
-        return jnp.bfloat16
-    table = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
-             5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
-             10: np.float16, 11: np.float64, 12: np.uint32,
-             13: np.uint64}
-    if code not in table:
+    from .proto import DTYPE_CODES
+    name = next((n for n, c in DTYPE_CODES.items() if c == code), None)
+    if name is None:
         raise NotImplementedError(
             f"Cast to TensorProto dtype code {code} not supported")
-    return table[code]
+    if name == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return np.dtype(name)
 
 
 @imports("Cast")

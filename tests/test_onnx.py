@@ -61,6 +61,32 @@ def test_mlp_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_new_op_roundtrip(tmp_path):
+    """Round-trip the round-4 op additions: Flatten / Squeeze /
+    Unsqueeze / Cast / Clip / LeakyRelu / Pow / Erf."""
+    rng = np.random.RandomState(3)
+    x = ht.Variable("x", trainable=False)
+    h = ht.unsqueeze_op(x, [1])                  # [B,1,6]
+    h = ht.flatten_op(h, 1)                      # [B,6]
+    h = ht.leaky_relu_op(h, 0.2)
+    h = ht.clip_op(h, -0.5, 0.5)
+    h = ht.power_op(h, 2.0)
+    from hetu_tpu.ops.basic import erf_op
+    h = erf_op(h)
+    h = ht.cast_op(h, np.float32)
+    h = ht.unsqueeze_op(h, [2])                  # [B,6,1]
+    y = ht.squeeze_op(h, [2])                    # [B,6]
+    exe = Executor([y])
+    xv = rng.randn(5, 6).astype(np.float32)
+    want = exe.run(feed_dict={x: xv}, convert_to_numpy_ret_vals=True)[0]
+
+    path = str(tmp_path / "newops.onnx")
+    export(exe, [x], [y], path)
+    outputs, feeds = load_onnx(path)
+    got = _run(outputs, {feeds[0]: xv})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_cnn_roundtrip(tmp_path):
     """Conv + pool + reshape + dense head round trip."""
     rng = np.random.RandomState(1)

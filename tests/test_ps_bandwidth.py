@@ -1,0 +1,67 @@
+"""PS transport bandwidth microbench (reference parity:
+tests/pstests/test_bandwidth.py times DDPushPull over the van).  Asserts
+only a loose floor — the printed numbers are the artifact."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import client as ps_client
+from hetu_tpu.ps import server as ps_server
+
+
+@pytest.fixture()
+def ps_env():
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    yield client
+    client.shutdown_servers()
+    client.close()
+    ps_server.shutdown_server()
+
+
+def test_dd_pushpull_bandwidth(ps_env):
+    n = 1 << 20                       # 4MB payload each way
+    ps_env.init_tensor(1, (n,), opt="SGD", lrs=(0.0,))
+    grad = np.ones(n, np.float32)
+    out = np.empty(n, np.float32)
+    ps_env.dd_pushpull(1, grad, out)
+    ps_env.wait(1)
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ps_env.dd_pushpull(1, grad, out)
+        ps_env.wait(1)
+    dt = time.perf_counter() - t0
+    mbps = reps * 2 * grad.nbytes / dt / 1e6
+    print(f"\nDDPushPull: {mbps:.0f} MB/s bidirectional "
+          f"({dt / reps * 1000:.2f} ms per 4MB+4MB round trip)")
+    assert mbps > 50, "loopback PS transport should exceed 50 MB/s"
+
+
+def test_sparse_push_pull_bandwidth(ps_env):
+    rows, width = 16384, 128          # 8MB of rows
+    ps_env.init_tensor(2, (1 << 20, width), opt="SGD", lrs=(0.0,))
+    ids = np.arange(rows, dtype=np.int64)
+    vals = np.ones((rows, width), np.float32)
+    ps_env.sparse_push(2, ids, vals, width)
+    ps_env.wait(2)
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ps_env.sparse_push(2, ids, vals, width)
+        ps_env.wait(2)
+    push_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ps_env.sparse_pull(2, ids, width)
+    pull_dt = time.perf_counter() - t0
+    nbytes = rows * width * 4
+    print(f"\nSparsePush: {reps * nbytes / push_dt / 1e6:.0f} MB/s, "
+          f"SparsePull: {reps * nbytes / pull_dt / 1e6:.0f} MB/s")
+    assert reps * nbytes / push_dt / 1e6 > 50
+    assert reps * nbytes / pull_dt / 1e6 > 50
