@@ -13,3 +13,5 @@ from .bert import (BertConfig, BertModel, BertForPreTraining,
 from .ctr import (wdl_criteo, wdl_adult, deepfm_criteo, dcn_criteo,
                   dc_criteo)
 from .gnn import gcn_layer, gcn, graphsage
+from .ncf import neural_mf
+from .transformer import Transformer, TransformerConfig

@@ -88,15 +88,27 @@ class _Exporter:
             handler(self, node)
         for out in self.outputs:
             shape = tuple(getattr(out, "inferred_shape", None) or ())
-            # a CastOp output's declared dtype must match its target
-            # (external runtimes type-check the graph outputs)
-            dt = proto.TENSOR_FLOAT
-            if type(out).__name__ == "CastOp":
-                dt = DTYPE_CODES.get(np.dtype(out.dtype).name,
-                                     proto.TENSOR_FLOAT)
             self.graph.outputs.append(
-                ValueInfo(self.name(out), dt, shape))
+                ValueInfo(self.name(out), _node_dtype(out), shape))
         return self.graph
+
+
+def _node_dtype(node, _depth=0):
+    """TensorProto dtype code of a graph node's value: a Cast pins it,
+    integer feeds carry ``dtype``, and shape/arithmetic ops preserve
+    their input's — external runtimes type-check the declared graph
+    outputs, so this must follow the value through trailing ops."""
+    if _depth > 256 or node is None:
+        return proto.TENSOR_FLOAT
+    if type(node).__name__ == "CastOp":
+        return DTYPE_CODES.get(np.dtype(node.dtype).name,
+                               proto.TENSOR_FLOAT)
+    dt = getattr(node, "dtype", None)
+    if dt is not None and np.issubdtype(np.dtype(dt), np.integer):
+        return DTYPE_CODES.get(np.dtype(dt).name, proto.TENSOR_INT64)
+    if getattr(node, "inputs", None):
+        return _node_dtype(node.inputs[0], _depth + 1)
+    return proto.TENSOR_FLOAT
 
 
 # -- handlers ---------------------------------------------------------------

@@ -22,16 +22,16 @@ TENSOR_FLOAT = 1
 TENSOR_INT32 = 6
 TENSOR_INT64 = 7
 
-NP_TO_ONNX = {np.dtype(np.float32): TENSOR_FLOAT,
-              np.dtype(np.int32): TENSOR_INT32,
-              np.dtype(np.int64): TENSOR_INT64}
-
-# full numpy-name -> TensorProto data-type code table (single source of
-# truth: hetu2onnx Cast export and onnx2hetu Cast import both use it)
+# full numpy-name -> TensorProto data-type code table — the single
+# source of truth (hetu2onnx Cast export / output typing, onnx2hetu Cast
+# import, and the serializer's NP_TO_ONNX all derive from it)
 DTYPE_CODES = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4,
                "int16": 5, "int32": 6, "int64": 7, "bool": 9,
                "float16": 10, "float64": 11, "uint32": 12,
                "uint64": 13, "bfloat16": 16}
+
+NP_TO_ONNX = {np.dtype(name): code for name, code in DTYPE_CODES.items()
+              if name != "bfloat16"}       # np.dtype can't name bf16
 ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
 
 # AttributeProto.AttributeType
