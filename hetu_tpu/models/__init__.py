@@ -2,9 +2,11 @@
 
 Reference parity: examples/cnn/models/ (LogReg, MLP, CNN, LeNet, AlexNet,
 VGG, ResNet, RNN, LSTM), examples/nlp/bert/hetu_bert.py (BERT family),
-examples/ctr/models/ (WDL, DeepFM, DCN, DC), examples/gnn/gnn_model (GCN,
-GraphSAGE). Each builder takes placeholder nodes and returns (loss, y)
-graph nodes, exactly like the reference's ``model(x, y_)`` convention.
+examples/nlp/hetu_transformer.py (seq2seq Transformer),
+examples/ctr/models/ (WDL, DeepFM, DCN, DC), examples/rec/hetu_ncf.py
+(NCF/NeuMF), examples/gnn/gnn_model (GCN, GraphSAGE). Each builder takes
+placeholder nodes and returns (loss, y) graph nodes, exactly like the
+reference's ``model(x, y_)`` convention.
 """
 from .cnn import (logreg, mlp, cnn_3_layers, lenet, alexnet, vgg16, vgg19,
                   resnet18, resnet34, rnn, lstm)

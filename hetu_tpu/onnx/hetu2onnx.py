@@ -93,20 +93,33 @@ class _Exporter:
         return self.graph
 
 
+# ops whose output dtype equals their (first) input's — the only ones
+# the declared-dtype walk may pass through; anything else (OneHot,
+# matmul, losses, ...) produces float in this framework
+_DTYPE_PRESERVING = {
+    "ArrayReshapeOp", "TransposeOp", "SqueezeOp", "UnsqueezeOp",
+    "FlattenOp", "SliceOp", "PadOp", "ConcatOp", "ConcatenateOp",
+    "SplitOp", "BroadcastToOp", "BroadcastShapeOp", "ClipOp",
+    "DropoutOp", "AbsOp", "OppositeOp",
+}
+
+
 def _node_dtype(node, _depth=0):
     """TensorProto dtype code of a graph node's value: a Cast pins it,
-    integer feeds carry ``dtype``, and shape/arithmetic ops preserve
-    their input's — external runtimes type-check the declared graph
-    outputs, so this must follow the value through trailing ops."""
+    integer feeds carry ``dtype``, and dtype-preserving shape ops pass
+    their input's through — external runtimes type-check the declared
+    graph outputs, so this must follow the value through trailing ops
+    (and must NOT walk through dtype-changing ops like OneHot)."""
     if _depth > 256 or node is None:
         return proto.TENSOR_FLOAT
-    if type(node).__name__ == "CastOp":
+    kind = type(node).__name__
+    if kind == "CastOp":
         return DTYPE_CODES.get(np.dtype(node.dtype).name,
                                proto.TENSOR_FLOAT)
     dt = getattr(node, "dtype", None)
     if dt is not None and np.issubdtype(np.dtype(dt), np.integer):
         return DTYPE_CODES.get(np.dtype(dt).name, proto.TENSOR_INT64)
-    if getattr(node, "inputs", None):
+    if kind in _DTYPE_PRESERVING and getattr(node, "inputs", None):
         return _node_dtype(node.inputs[0], _depth + 1)
     return proto.TENSOR_FLOAT
 
