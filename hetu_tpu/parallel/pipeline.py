@@ -124,6 +124,26 @@ def _device_key(node):
     return ((first.hostname, first.device_id),)
 
 
+def _drive_1f1b(forward, backward, nstages, M):
+    """The 1F1B order: min(nstages, M) warmup forwards, then alternate
+    backward/forward, then drain. ONE definition — the in-process,
+    fused (trace-time), and cross-process runners all execute exactly
+    this sequence, which is what makes their losses bit-equivalent."""
+    warmup = min(nstages, M)
+    done_f = done_b = 0
+    for _ in range(warmup):
+        forward(done_f)
+        done_f += 1
+    while done_f < M:
+        backward(done_b)
+        done_b += 1
+        forward(done_f)
+        done_f += 1
+    while done_b < M:
+        backward(done_b)
+        done_b += 1
+
+
 def _owner_of(hostname, nprocs):
     """Worker-process rank that owns a stage hostname (reference device
     specs 'hostname:gpu:i', context.py:59-63). Conventions:
@@ -279,11 +299,6 @@ class PipelineSubExecutor:
             st.owner = _owner_of(key[0][0], nprocs)
         self.multiproc = (nprocs > 1
                           and len({s.owner for s in stages}) > 1)
-        if self.multiproc and self.schedule != "gpipe":
-            raise NotImplementedError(
-                "cross-process pipeline stages support the gpipe "
-                "schedule; 1F1B's per-microbatch updates need rank-"
-                "interleaved dispatch (in-process 1F1B is unaffected)")
         if self.multiproc:
             # a stage's device indexes the OWNER's local devices (after
             # jax.distributed, jax.devices() is global and remote entries
@@ -601,19 +616,7 @@ class PipelineSubExecutor:
                                               else prev + d)
                 del stash[m]
 
-            warmup = min(len(stages), M)
-            done_f = done_b = 0
-            for _ in range(warmup):
-                forward(done_f)
-                done_f += 1
-            while done_f < M:
-                backward(done_b)
-                done_b += 1
-                forward(done_f)
-                done_f += 1
-            while done_b < M:
-                backward(done_b)
-                done_b += 1
+            _drive_1f1b(forward, backward, len(stages), M)
             return cur, opt, jnp.mean(jnp.stack(losses))
 
         self._fused_step = jax.jit(step_fn)
@@ -646,6 +649,9 @@ class PipelineSubExecutor:
         """Global batch -> per-microbatch feed lists per stage."""
         per_stage = []
         for stage in self.stages:
+            if self.multiproc and stage.owner != self.my_rank:
+                per_stage.append([])     # remote stage feeds itself
+                continue
             feeds_m = []
             for m in range(m_total):
                 vals = []
@@ -705,6 +711,9 @@ class PipelineSubExecutor:
         if self._fused_step is not None:
             loss = self._run_fused(executor,
                                    self._stack_feeds(feed_dict, M))
+        elif self.multiproc and self.schedule != "gpipe":
+            feeds = self._split_feeds(feed_dict, M)
+            loss = self._run_1f1b_multiproc(executor, feeds, M)
         elif self.multiproc:
             loss = self._run_gpipe_multiproc(
                 executor, self._stack_feeds(feed_dict, M), M)
@@ -897,6 +906,108 @@ class PipelineSubExecutor:
                                       new_state)
         return loss_mean
 
+    def _run_1f1b_multiproc(self, executor, feeds, M):
+        """1F1B across worker processes: each rank executes its
+        projection of the SAME global schedule as the in-process
+        `_run_1f1b` (uniform warmup, then alternate), so the math —
+        which weight version each microbatch's forward sees — is
+        bit-identical to single-process PipeDream; blocking channel
+        recvs turn the data dependencies into the cross-rank schedule
+        (the channel's reader thread drains sockets, so sends never
+        rendezvous and the projected order cannot deadlock). Returns
+        the per-step mean loss on the loss-owning rank, None elsewhere
+        (same contract as `_run_gpipe_multiproc`)."""
+        from .p2p import get_channel
+        ch = get_channel()
+        sc = self.step_count
+        base_rng = executor.base_rng
+        lr = np.float32(self.optimizer.learning_rate)
+        step = np.int32(self.step_count)
+        own = [s for s in self.stages if s.owner == self.my_rank]
+        loss_sidx = self.assign[self.loss_node]
+        env_out, stage_ins, stash, cot_map = {}, {}, {}, {}
+        losses = []
+
+        def consumers_of(node):
+            return [s for s in self.stages if node in s.in_nodes]
+
+        def forward(m):
+            stash[m] = {s.index: dict(s.params) for s in own}
+            for stage in own:
+                ins = []
+                for node in stage.in_nodes:
+                    src = self.stages[self.assign[node]]
+                    if src.owner == self.my_rank:
+                        val = env_out[(m, src.index)][
+                            src.out_nodes.index(node)]
+                    else:
+                        val = ch.recv(
+                            f"pf{sc}:{m}:{node.id}:{stage.index}")
+                    ins.append(stage.put(val))
+                outs = stage.fwd(stage.params, ins,
+                                 feeds[stage.index][m], base_rng, step,
+                                 np.int32(m))
+                env_out[(m, stage.index)] = outs
+                stage_ins[(m, stage.index)] = ins
+                for node in stage.consumed_outs:
+                    val = None
+                    for cons in consumers_of(node):
+                        if cons.owner == self.my_rank:
+                            continue
+                        if val is None:   # one d2h per boundary tensor
+                            val = np.asarray(
+                                outs[stage.out_nodes.index(node)])
+                        ch.send(cons.owner,
+                                f"pf{sc}:{m}:{node.id}:{cons.index}",
+                                val)
+            if self.stages[loss_sidx].owner == self.my_rank:
+                losses.append(env_out[(m, loss_sidx)][
+                    self.stages[loss_sidx].out_nodes.index(
+                        self.loss_node)])
+
+        def backward(m):
+            for stage in reversed(own):
+                cots = []
+                for node in stage.out_nodes:
+                    c = cot_map.get((m, node))
+                    for cons in consumers_of(node):
+                        if cons.owner == self.my_rank:
+                            continue   # local consumers summed in map
+                        d = stage.put(ch.recv(
+                            f"pb{sc}:{m}:{node.id}:{cons.index}"))
+                        c = d if c is None else c + d
+                    cots.append(c)
+                dins, new_params, new_state = stage.bwd_apply(
+                    stash[m][stage.index], stage.params,
+                    stage_ins.pop((m, stage.index)),
+                    feeds[stage.index][m], base_rng, step, np.int32(m),
+                    cots, self._stage_opt_state(executor, stage), lr)
+                for node, d in zip(stage.in_nodes, dins):
+                    src = self.stages[self.assign[node]]
+                    if src.owner == self.my_rank:
+                        d = src.put(d)
+                        prev = cot_map.get((m, node))
+                        cot_map[(m, node)] = d if prev is None \
+                            else prev + d
+                    else:
+                        ch.send(src.owner,
+                                f"pb{sc}:{m}:{node.id}:{stage.index}",
+                                np.asarray(d))
+                self._commit_stage_update(executor, stage, new_params,
+                                          new_state)
+            del stash[m]
+            for s in own:
+                env_out.pop((m, s.index), None)
+            # boundary cotangents were consumed within this backward
+            # (reversed stage order): free them with the stash
+            for key in [k for k in cot_map if k[0] == m]:
+                del cot_map[key]
+
+        _drive_1f1b(forward, backward, len(self.stages), M)
+        if losses:
+            return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+        return None
+
     def _run_1f1b(self, executor, feeds, M):
         """1F1B: warmup forwards then alternate, per-microbatch updates
         with stashed weights (reference SubExecutor4Pipedream)."""
@@ -908,7 +1019,6 @@ class PipelineSubExecutor:
         lr = np.float32(self.optimizer.learning_rate)
         step = np.int32(self.step_count)
         nstages = len(self.stages)
-        warmup = min(nstages, M)
         cot_map = {}
 
         def forward(m):
@@ -937,16 +1047,5 @@ class PipelineSubExecutor:
                                           new_state)
             del stash[m]
 
-        done_f = done_b = 0
-        for _ in range(warmup):
-            forward(done_f)
-            done_f += 1
-        while done_f < M:
-            backward(done_b)
-            done_b += 1
-            forward(done_f)
-            done_f += 1
-        while done_b < M:
-            backward(done_b)
-            done_b += 1
+        _drive_1f1b(forward, backward, nstages, M)
         return losses           # device values: no host sync per loss
