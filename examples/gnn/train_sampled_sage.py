@@ -1,0 +1,184 @@
+"""Minibatch GraphSAGE over sampled subgraphs (reference parity:
+examples/gnn/run_single.py — the reference samples per-batch subgraphs
+through GraphMix graph servers and double-buffers them with
+``GNNDataLoaderOp.step``; GraphMix is an empty submodule in the
+snapshot, so the sampler here is an in-process numpy neighbor sampler
+playing the same role).
+
+TPU-first design point: every sampled subgraph is padded to a FIXED
+node and edge budget (isolated dummy nodes / zero-valued edges), so the
+whole training step compiles once — no per-batch recompiles from
+ragged subgraph shapes.
+
+    python examples/gnn/train_sampled_sage.py --timing
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import hetu_tpu as ht                                   # noqa: E402
+from hetu_tpu.dataloader import GNNDataLoaderOp         # noqa: E402
+from hetu_tpu.models import graphsage                   # noqa: E402
+
+
+def make_graph(n=4000, deg=8, fdim=64, ncls=7, seed=0):
+    """Planted-signal random graph (same recipe as train_hetu_gcn)."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(seed)
+    rows = np.repeat(np.arange(n), deg)
+    cols = rng.randint(0, n, n * deg)
+    adj = sp.coo_matrix((np.ones(n * deg, np.float32), (rows, cols)),
+                        shape=(n, n)).tocsr()
+    y = rng.randint(0, ncls, n)
+    feat = rng.randn(n, fdim).astype(np.float32)
+    block = fdim // ncls
+    for c in range(ncls):
+        feat[y == c, c * block:(c + 1) * block] += 0.4
+    return adj, feat, np.eye(ncls, dtype=np.float32)[y]
+
+
+class SubgraphSampler:
+    """Seed-batch -> fixed-budget induced subgraph with degree-normalized
+    CSR adjacency (the GraphMix-server role, in process)."""
+
+    def __init__(self, adj, feat, onehot, batch_seeds, fanout=8, seed=0):
+        self.adj = adj
+        self.feat = feat
+        self.onehot = onehot
+        self.batch_seeds = batch_seeds
+        self.fanout = fanout
+        self.rng = np.random.RandomState(seed)
+        self.n_sub = batch_seeds * (fanout + 1)
+        self.nnz_budget = self.n_sub * (fanout + 2)
+        self.order = self.rng.permutation(adj.shape[0])
+        self.cursor = 0
+
+    def _neighbors(self, v):
+        return self.adj.indices[self.adj.indptr[v]:self.adj.indptr[v + 1]]
+
+    def next(self):
+        n = self.adj.shape[0]
+        if self.cursor + self.batch_seeds > n:
+            self.order = self.rng.permutation(n)
+            self.cursor = 0
+        seeds = self.order[self.cursor:self.cursor + self.batch_seeds]
+        self.cursor += self.batch_seeds
+
+        nodes = list(seeds)
+        seen = set(int(s) for s in seeds)
+        for s in seeds:
+            nbrs = self._neighbors(int(s))
+            if len(nbrs) > self.fanout:
+                nbrs = self.rng.choice(nbrs, self.fanout, replace=False)
+            for v in nbrs:
+                v = int(v)
+                if v not in seen and len(nodes) < self.n_sub:
+                    seen.add(v)
+                    nodes.append(v)
+        nodes = np.asarray(nodes, np.int64)
+        n_real = len(nodes)
+        loc = {int(g): i for i, g in enumerate(nodes)}
+
+        rows, cols = [], []
+        for i, g in enumerate(nodes):
+            rows.append(i)
+            cols.append(i)                      # self loop
+            for v in self._neighbors(int(g)):
+                j = loc.get(int(v))
+                if j is not None:
+                    rows.append(i)
+                    cols.append(j)
+        rows = np.asarray(rows)[:self.nnz_budget]
+        cols = np.asarray(cols)[:self.nnz_budget]
+        deg = np.bincount(rows, minlength=self.n_sub).astype(np.float32)
+        vals = (1.0 / np.maximum(deg, 1.0))[rows]
+
+        # fixed-budget CSR: pad rows beyond n_real empty, absorb unused
+        # nnz as zero-valued self-edges of node 0 (no numeric effect)
+        pad = self.nnz_budget - len(rows)
+        indptr = np.zeros(self.n_sub + 1, np.int32)
+        counts = np.bincount(rows, minlength=self.n_sub)
+        order = np.argsort(rows, kind="stable")
+        data = np.concatenate([vals[order],
+                               np.zeros(pad, np.float32)])
+        indices = np.concatenate([cols[order],
+                                  np.zeros(pad, np.int32)]).astype(
+                                      np.int32)
+        counts[self.n_sub - 1] += pad           # padding lives in last row
+        indptr[1:] = np.cumsum(counts)
+
+        feat = np.zeros((self.n_sub, self.feat.shape[1]), np.float32)
+        feat[:n_real] = self.feat[nodes]
+        y = np.zeros((self.n_sub, self.onehot.shape[1]), np.float32)
+        y[:n_real] = self.onehot[nodes]
+        mask = np.zeros(self.n_sub, np.float32)
+        mask[:len(seeds)] = 1.0                 # loss on seed nodes only
+        sp_adj = ht.ND_Sparse_Array(data, indptr, indices,
+                                    nrow=self.n_sub, ncol=self.n_sub)
+        return {"feat": feat, "y": y, "mask": mask, "adj": sp_adj}
+
+
+def main(args):
+    adj, feat_arr, onehot = make_graph(args.nodes, fdim=args.features,
+                                       ncls=args.classes)
+    sampler = SubgraphSampler(adj, feat_arr, onehot, args.batch_seeds,
+                              fanout=args.fanout)
+
+    feat = GNNDataLoaderOp(lambda g: g["feat"])
+    y_ = GNNDataLoaderOp(lambda g: g["y"])
+    mask_ = GNNDataLoaderOp(lambda g: g["mask"])
+    norm_adj = GNNDataLoaderOp(lambda g: g["adj"])
+    loss, y, train_op = graphsage(
+        feat, y_, mask_, norm_adj, args.features, args.hidden_size,
+        args.classes, lr=args.learning_rate)
+    train_loss = ht.reduce_mean_op(ht.mul_op(loss, mask_), [0])
+    exe = ht.Executor([train_loss, train_op])
+
+    # double-buffer bring-up: current + next (reference run_single.py)
+    GNNDataLoaderOp.step(sampler.next())
+    GNNDataLoaderOp.step(sampler.next())
+    nbatches = args.nodes // args.batch_seeds
+    results = {}
+    for ep in range(args.num_epoch):
+        ep_st = time.time()
+        ep_loss = []
+        for _ in range(nbatches):
+            GNNDataLoaderOp.step(sampler.next())   # prepare next batch
+            out = exe.run(feed_dict={})
+            ep_loss.append(float(np.mean(out[0].asnumpy())))
+        dt = time.time() - ep_st
+        msg = f"epoch {ep}: loss {np.mean(ep_loss):.4f}"
+        if args.timing:
+            sps = nbatches * args.batch_seeds / dt
+            msg += f", {dt:.2f}s ({sps:.0f} seed nodes/sec)"
+            results["nodes_per_sec"] = sps
+        print(msg, flush=True)
+        results["loss"] = float(np.mean(ep_loss))
+    assert len(exe.subexecutors["default"].compiled) == 1, \
+        "fixed budgets must yield exactly one compiled step"
+    exe.close()
+    return results
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=4000)
+    parser.add_argument("--features", type=int, default=64)
+    parser.add_argument("--classes", type=int, default=7)
+    parser.add_argument("--hidden-size", type=int, default=64)
+    parser.add_argument("--batch-seeds", type=int, default=64)
+    parser.add_argument("--fanout", type=int, default=8)
+    parser.add_argument("--num-epoch", type=int, default=5)
+    parser.add_argument("--learning-rate", type=float, default=0.5)
+    parser.add_argument("--timing", action="store_true")
+    return parser.parse_args(argv)
+
+
+if __name__ == "__main__":
+    main(parse_args())

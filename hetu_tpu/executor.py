@@ -88,6 +88,11 @@ class HetuConfig:
     Resolves the communication mode from device groups, builds the device
     mesh, and runs the backward/forward hook pass that splices
     communication ops into the graph.
+
+    ``dynamic_memory`` and ``enable_lazy`` are accepted for reference
+    API compatibility and intentionally no-ops here: XLA's buffer
+    assignment + donation subsume the reference's ref-count pool and
+    lazy strided views (executor.py:1561-1612, ndarray.py:167-169).
     """
 
     def __init__(self, eval_node_list, train_name="default",

@@ -291,6 +291,12 @@ class PipelineSubExecutor:
             st.consumed_outs = [n for n in st.out_nodes if n in all_ins]
         self.assign = assign
         self.stages = stages
+        # node -> consuming stages, precomputed once (both multiproc
+        # runners walk boundary consumers per node)
+        self._consumers = {}
+        for st in stages:
+            for node in st.in_nodes:
+                self._consumers.setdefault(node, []).append(st)
         # multi-process ownership: stages whose hostname maps to another
         # worker rank execute there; boundaries cross via the p2p channel
         self.my_rank = int(os.environ.get("HETU_PROC_ID", "0"))
@@ -838,8 +844,7 @@ class PipelineSubExecutor:
         step = np.int32(self.step_count)
         sc = self.step_count
 
-        def consumers_of(node):
-            return [s for s in self.stages if node in s.in_nodes]
+        consumers_of = lambda node: self._consumers.get(node, ())  # noqa: E731
 
         env = {}
         ins_store = {}
@@ -928,8 +933,7 @@ class PipelineSubExecutor:
         env_out, stage_ins, stash, cot_map = {}, {}, {}, {}
         losses = []
 
-        def consumers_of(node):
-            return [s for s in self.stages if node in s.in_nodes]
+        consumers_of = lambda node: self._consumers.get(node, ())  # noqa: E731
 
         def forward(m):
             stash[m] = {s.index: dict(s.params) for s in own}
@@ -1046,6 +1050,13 @@ class PipelineSubExecutor:
                 self._commit_stage_update(executor, stage, new_params,
                                           new_state)
             del stash[m]
+            # free this microbatch's activations/cotangents with its
+            # stash — 1F1B's bounded in-flight memory depends on it
+            for stage in self.stages:
+                env_out.pop((m, stage.index), None)
+                stage_ins.pop((m, stage.index), None)
+            for key in [k for k in cot_map if k[0] == m]:
+                del cot_map[key]
 
         _drive_1f1b(forward, backward, nstages, M)
         return losses           # device values: no host sync per loss

@@ -192,3 +192,38 @@ def test_instance_norm_and_bn_shapes():
     var = xv.var(axis=(2, 3), keepdims=True)
     np.testing.assert_allclose(out, (xv - mean) / np.sqrt(var + 1e-5),
                                rtol=1e-3)
+
+
+def test_new_shape_ops_and_clip():
+    """Round-4 op additions: forward numerics + gradients of
+    flatten/squeeze/unsqueeze/clip/cast (the ONNX-importer vocabulary;
+    clip's gradient masks the clamped region)."""
+    xv = rand(2, 3, 4, seed=9)
+    x = ht.Variable("x", value=xv)
+    fl, sq, us, cl, ca = run_graph([
+        ht.flatten_op(x, 1),
+        ht.squeeze_op(ht.unsqueeze_op(x, [1]), [1]),
+        ht.unsqueeze_op(x, [0, 4]),
+        ht.clip_op(x, -0.5, 0.5),
+        ht.cast_op(x, np.int32)])
+    np.testing.assert_allclose(fl, xv.reshape(2, 12), rtol=1e-6)
+    np.testing.assert_allclose(sq, xv, rtol=1e-6)
+    assert us.shape == (1, 2, 3, 4, 1)
+    np.testing.assert_allclose(cl, np.clip(xv, -0.5, 0.5), rtol=1e-6)
+    # (float64 would downcast: jax x64 mode is off by default)
+    assert ca.dtype == np.int32
+
+    # gradients: reshape family passes through; clip masks the interior
+    y = ht.Variable("y", value=xv)
+    loss = ht.reduce_mean_op(
+        ht.flatten_op(ht.clip_op(y, -0.5, 0.5), 1), [0, 1])
+    (gy,) = run_graph(ht.gradients(loss, [y]))
+    want = ((np.abs(xv) <= 0.5).astype(np.float32)) / xv.size
+    np.testing.assert_allclose(gy, want, rtol=1e-5)
+
+    z = ht.Variable("z", value=xv)
+    loss2 = ht.reduce_mean_op(
+        ht.squeeze_op(ht.unsqueeze_op(z, [2]), [2]), [0, 1, 2])
+    (gz,) = run_graph(ht.gradients(loss2, [z]))
+    np.testing.assert_allclose(gz, np.full_like(xv, 1.0 / xv.size),
+                               rtol=1e-5)
