@@ -27,6 +27,7 @@ from . import initializers as init
 from . import data
 from . import metrics
 from . import launcher
+from . import stream
 
 __version__ = "0.1.0"
 
