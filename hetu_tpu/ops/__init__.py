@@ -44,7 +44,8 @@ from .norm import (
 )
 from .embedding import embedding_lookup_op, embedding_lookup_gradient_op
 from .sparse import csrmv_op, csrmm_op, distgcn_15d_op
-from .attention import flash_attention_op, ring_attention_op
+from .attention import (flash_attention_op, ring_attention_op,
+                        ulysses_attention_op)
 from .comm import (
     allreduceCommunicate_op, groupallreduceCommunicate_op,
     parameterServerCommunicate_op, parameterServerSparsePull_op,

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from ..graph.node import Op
 
 __all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference",
-           "ring_attention_op", "RingAttentionOp"]
+           "ring_attention_op", "RingAttentionOp",
+           "ulysses_attention_op", "UlyssesAttentionOp"]
 
 
 def attention_reference(q, k, v, mask, sm_scale):
@@ -173,61 +174,110 @@ def _sp_mesh(ectx):
     return None
 
 
-class RingAttentionOp(FlashAttentionOp):
-    """Sequence-parallel attention over [B, H, S, D]: the sequence dim
-    shards over the mesh's "sp" axis and K/V shards rotate around the
-    ICI ring with online-softmax merging (parallel/ring.py). Forward AND
-    backward run sharded — per-chip attention memory is O(S/n · D), the
-    long-context scaling the reference lacks (SURVEY §5).
+class _SeqParallelAttentionOp(FlashAttentionOp):
+    """Base for sequence-parallel attention ops: subclasses name the
+    sharded implementation (parallel/ring.py or parallel/ulysses.py);
+    compute/gradient plumbing — mesh detection, vjp-through-shard_map
+    backward, fused-path fallback — lives here once.
 
-    Falls back to the fused single-device path when the session mesh has
-    no "sp" axis, so models declare sequence parallelism once and run
-    anywhere."""
+    Falls back to the fused single-device path when the session mesh
+    has no "sp" axis, so models declare sequence parallelism once and
+    run anywhere. Causal masking is not implemented on the sharded
+    paths (bidirectional-encoder semantics); a causal instance fails
+    fast rather than silently changing numerics with the mesh."""
+
+    _impl = None            # staticmethod (q, k, v, mesh, axis_name,
+    _cache_prefix = None    #               sm_scale, mask) -> out
+
+    def _sharded(self, q, k, v, mask, mesh):
+        if self.causal:
+            raise NotImplementedError(
+                f"{type(self).__name__}: causal masking is not "
+                "supported on the sequence-parallel path")
+        return type(self)._impl(q, k, v, mesh, axis_name="sp",
+                                sm_scale=self.sm_scale, mask=mask)
 
     def compute(self, input_vals, ectx):
         mesh = _sp_mesh(ectx)
         if mesh is None:
             return super().compute(input_vals, ectx)
-        from ..parallel.ring import ring_attention_sharded
         q, k, v = input_vals[:3]
         mask = input_vals[3] if self.has_mask else None
-        return ring_attention_sharded(q, k, v, mesh, axis_name="sp",
-                                      sm_scale=self.sm_scale, mask=mask)
+        return self._sharded(q, k, v, mask, mesh)
 
     def gradient(self, output_grad):
-        grads = [_RingAttentionGradOp(self, output_grad, i,
-                                      ctx=self.raw_ctx)
+        grads = [_SeqParallelAttentionGradOp(self, output_grad, i,
+                                             ctx=self.raw_ctx)
                  for i in range(3)]
         if self.has_mask:
             grads.append(None)
         return grads
 
 
-class _RingAttentionGradOp(_FlashAttentionGradOp):
-    """dq/dk/dv through the ring itself (ppermute transposes to the
-    reverse rotation), so the backward is sequence-sharded too."""
+class _SeqParallelAttentionGradOp(_FlashAttentionGradOp):
+    """dq/dk/dv through the sharded program itself (jax.vjp transposes
+    the collectives — reverse ppermute rotation for the ring, mirrored
+    all-to-alls for Ulysses), so the backward stays sequence-sharded."""
 
     def compute(self, input_vals, ectx):
         mesh = _sp_mesh(ectx)
         if mesh is None:
             return super().compute(input_vals, ectx)
-        from ..parallel.ring import ring_attention_sharded
         fwd = self.forward_op
         nin = 4 if fwd.has_mask else 3
         q, k, v = input_vals[:3]
         mask = input_vals[3] if fwd.has_mask else None
         dy = input_vals[nin]
-        cache_key = ("ringattn_vjp", fwd.id)
+        cache_key = (type(fwd)._cache_prefix, fwd.id)
         if cache_key not in ectx.cache:
             def f(q_, k_, v_):
-                return ring_attention_sharded(
-                    q_, k_, v_, mesh, axis_name="sp",
-                    sm_scale=fwd.sm_scale, mask=mask)
+                return fwd._sharded(q_, k_, v_, mask, mesh)
             _, vjp = jax.vjp(f, q, k, v)
             ectx.cache[cache_key] = vjp(dy)
         return ectx.cache[cache_key][self.which]
 
 
+def _ring_impl(q, k, v, mesh, axis_name, sm_scale, mask):
+    from ..parallel.ring import ring_attention_sharded
+    return ring_attention_sharded(q, k, v, mesh, axis_name=axis_name,
+                                  sm_scale=sm_scale, mask=mask)
+
+
+def _ulysses_impl(q, k, v, mesh, axis_name, sm_scale, mask):
+    from ..parallel.ulysses import ulysses_attention_sharded
+    return ulysses_attention_sharded(q, k, v, mesh, axis_name=axis_name,
+                                     sm_scale=sm_scale, mask=mask)
+
+
+class RingAttentionOp(_SeqParallelAttentionOp):
+    """Sequence-parallel attention over [B, H, S, D]: the sequence dim
+    shards over the mesh's "sp" axis and K/V shards rotate around the
+    ICI ring with online-softmax merging (parallel/ring.py). Forward AND
+    backward run sharded — per-chip attention memory is O(S/n . D), the
+    long-context scaling the reference lacks (SURVEY §5)."""
+
+    _impl = staticmethod(_ring_impl)
+    _cache_prefix = "ringattn_vjp"
+
+
+class UlyssesAttentionOp(_SeqParallelAttentionOp):
+    """Ulysses sequence parallelism: all-to-all swaps the sharded axis
+    from sequence to heads, blocked full-sequence attention runs per
+    head subset, a second all-to-all restores the sequence sharding
+    (parallel/ulysses.py). Two collectives per attention vs the ring's
+    n-1 ppermutes — prefer it when H >= n; needs H % n == 0."""
+
+    _impl = staticmethod(_ulysses_impl)
+    _cache_prefix = "ulyssesattn_vjp"
+
+
 def ring_attention_op(q, k, v, mask=None, sm_scale=1.0, ctx=None):
     """Sequence-parallel (ring) attention; see RingAttentionOp."""
     return RingAttentionOp(q, k, v, mask, sm_scale, causal=False, ctx=ctx)
+
+
+def ulysses_attention_op(q, k, v, mask=None, sm_scale=1.0, ctx=None):
+    """Sequence-parallel (Ulysses all-to-all) attention; see
+    UlyssesAttentionOp."""
+    return UlyssesAttentionOp(q, k, v, mask, sm_scale, causal=False,
+                              ctx=ctx)

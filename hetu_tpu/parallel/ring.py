@@ -68,10 +68,11 @@ def ring_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
     return (o / l).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
-                           mask=None):
-    """shard_map wrapper: q/k/v are global [B, H, S, D]; the sequence dim
-    shards over ``axis_name`` of ``mesh`` and the ring runs over ICI."""
+def shard_map_qkv(body_fn, q, k, v, mesh, axis_name, mask=None):
+    """Shared shard_map plumbing for sequence-parallel attention bodies
+    (ring and Ulysses): q/k/v are global [B, H, S, D] with the sequence
+    dim sharded over ``axis_name``; the additive key mask shards on its
+    last dim. ``body_fn(q, k, v, mask=...)`` runs per shard."""
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map
@@ -80,13 +81,20 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
 
     spec = P(None, None, axis_name, None)
     mask_spec = P(None, None, None, axis_name)
-    fn = functools.partial(ring_attention, axis_name=axis_name,
-                           sm_scale=sm_scale)
     if mask is not None:
-        body = lambda q_, k_, v_, m_: fn(q_, k_, v_, mask=m_)  # noqa: E731
+        body = lambda q_, k_, v_, m_: body_fn(q_, k_, v_, mask=m_)  # noqa: E731
         return shard_map(body, mesh=mesh,
                          in_specs=(spec, spec, spec, mask_spec),
                          out_specs=spec)(q, k, v, mask)
-    body = lambda q_, k_, v_: fn(q_, k_, v_)                   # noqa: E731
+    body = lambda q_, k_, v_: body_fn(q_, k_, v_)                   # noqa: E731
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
+                           mask=None):
+    """shard_map wrapper: q/k/v are global [B, H, S, D]; the sequence dim
+    shards over ``axis_name`` of ``mesh`` and the ring runs over ICI."""
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           sm_scale=sm_scale)
+    return shard_map_qkv(fn, q, k, v, mesh, axis_name, mask=mask)

@@ -1,0 +1,110 @@
+"""Ulysses sequence parallelism — all-to-all head/sequence exchange.
+
+The second context-parallel flavor next to ring attention (SURVEY §2.3
+names both; the reference has neither). Where the ring rotates K/V
+shards around ICI neighbors, Ulysses re-shards with two collectives:
+an all-to-all turns sequence-sharded [B, H, S/n, D] projections into
+head-sharded [B, H/n, S, D], each device computes full-sequence
+attention for its head subset, and a second all-to-all restores the
+sequence sharding. Two all-to-alls per attention instead of n-1
+ppermutes — the better trade when H >= n and the interconnect is fast
+relative to S (DeepSpeed-Ulysses's observation); requires H % n == 0,
+which the ring does not.
+
+The per-head-subset attention is blocked with the same online-softmax
+merge as the ring (never materializing the S x S score matrix), so the
+long-context memory profile survives the re-shard: O(S * block) scores
+per chip.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from .ring import shard_map_qkv
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def _blocked_attention(q, k, v, sm_scale, mask, block=1024):
+    """Full-sequence attention via a lax.scan over key blocks with the
+    online-softmax merge (the same rule parallel/ring.py applies across
+    devices, applied locally) — O(S*block) score memory."""
+    b, h, s, d = q.shape
+    if s % block:
+        block = s                      # odd lengths: single block
+    nblk = s // block
+    kb = k.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
+    if mask is not None:
+        maskb = mask.reshape(b, 1, 1, nblk, block).transpose(3, 0, 1, 2, 4)
+    else:
+        maskb = jnp.zeros((nblk, 1, 1, 1, block), jnp.float32)
+
+    def step(carry, xs):
+        m_acc, l_acc, o_acc = carry
+        k_, v_, mask_ = xs
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k_,
+                        preferred_element_type=jnp.float32) * sm_scale
+        sc = sc + mask_
+        m_blk = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m_blk)
+        l_blk = jnp.sum(p, axis=-1, keepdims=True)
+        o_blk = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_.dtype), v_)
+        m_new = jnp.maximum(m_acc, m_blk)
+        a_old = jnp.exp(m_acc - m_new)
+        a_blk = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a_old + l_blk * a_blk
+        o_new = o_acc * a_old + o_blk.astype(jnp.float32) * a_blk
+        return (m_new, l_new, o_new), None
+
+    # init carries derive from q so they inherit its varying-over-mesh
+    # type (a fresh constant would be unvarying and shard_map's scan
+    # rejects the carry-type mismatch)
+    m0 = jnp.zeros_like(q[..., :1], dtype=jnp.float32) - 1e30
+    l0 = jnp.zeros_like(q[..., :1], dtype=jnp.float32)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0), (kb, vb, maskb))
+    return (o / l).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
+    """Per-shard body (call inside shard_map).
+
+    q, k, v: local shards [B, H, S_local, D] (sequence sharded over
+    ``axis_name``); mask: optional additive [B, 1, 1, S_local] shard.
+    Non-causal (bidirectional-encoder semantics, like the ring body).
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[1]
+    assert h % n == 0, \
+        f"Ulysses needs heads ({h}) divisible by the sp axis ({n})"
+
+    def seq_to_heads(x):
+        # [B, H, S/n, D] -> [B, H/n, S, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    q_, k_, v_ = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if mask is not None:
+        # the additive key mask needs the full sequence on every device
+        mask_full = lax.all_gather(mask, axis_name, axis=-1, tiled=True)
+    else:
+        mask_full = None
+
+    o = _blocked_attention(q_, k_, v_, sm_scale, mask_full)
+
+    # [B, H/n, S, D] -> [B, H, S/n, D]
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True).astype(q.dtype)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
+                              mask=None):
+    """shard_map wrapper: q/k/v are global [B, H, S, D]; the sequence
+    dim shards over ``axis_name`` of ``mesh``."""
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           sm_scale=sm_scale)
+    return shard_map_qkv(fn, q, k, v, mesh, axis_name, mask=mask)

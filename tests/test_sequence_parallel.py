@@ -121,3 +121,86 @@ def test_bert_sequence_parallel_long_seq():
     got = float(exe.run(feed_dict=fd2,
                         convert_to_numpy_ret_vals=True)[0])
     np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_ulysses_attention_op_matches_fused():
+    """Ulysses (all-to-all) sequence parallelism on the 8-way sp mesh ==
+    fused single-device attention, gradients included. H=8 so heads
+    divide the axis."""
+    rng = np.random.RandomState(4)
+    b, h, s, d = 2, 8, 256, 8
+    qv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    kv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    vv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+
+    def build(op):
+        q = ht.Variable("ul_q", value=qv)
+        k = ht.Variable("ul_k", value=kv)
+        v = ht.Variable("ul_v", value=vv)
+        out = op(q, k, v, sm_scale=0.35)
+        loss = ht.reduce_mean_op(
+            ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+        train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        return loss, train, (q, k, v)
+
+    loss, train, nodes = build(ht.flash_attention_op)
+    ref = Executor([loss, train])
+    want = [float(ref.run(feed_dict={},
+                          convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    want_q = np.asarray(ref.params[str(nodes[0].id)])
+
+    loss2, train2, nodes2 = build(ht.ulysses_attention_op)
+    config = HetuConfig(eval_node_list=[loss2, train2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2, train2]}, config=config)
+    got = [float(exe.run(feed_dict={},
+                         convert_to_numpy_ret_vals=True)[0])
+           for _ in range(3)]
+    got_q = np.asarray(exe.params[str(nodes2[0].id)])
+
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(got_q, want_q, rtol=1e-3, atol=1e-5)
+
+
+def test_ulysses_attention_masked_matches_fused():
+    """Additive key mask (padding) through the all-gathered mask path."""
+    rng = np.random.RandomState(5)
+    b, h, s, d = 2, 8, 128, 8
+    qv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    kv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    vv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    mv = np.where(rng.rand(b, 1, 1, s) < 0.2, -1e9, 0.0).astype(
+        np.float32)
+
+    def build(op):
+        q = ht.Variable("um_q", value=qv)
+        k = ht.Variable("um_k", value=kv)
+        v = ht.Variable("um_v", value=vv)
+        m = ht.Variable("um_m", value=mv, trainable=False)
+        out = op(q, k, v, mask=m, sm_scale=0.35)
+        return ht.reduce_mean_op(
+            ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+
+    ref = Executor([build(ht.flash_attention_op)])
+    want = float(ref.run(feed_dict={},
+                         convert_to_numpy_ret_vals=True)[0])
+
+    loss2 = build(ht.ulysses_attention_op)
+    config = HetuConfig(eval_node_list=[loss2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2]}, config=config)
+    got = float(exe.run(feed_dict={},
+                        convert_to_numpy_ret_vals=True)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_ulysses_fallback_off_mesh():
+    rng = np.random.RandomState(6)
+    q = ht.Variable("uf_q", value=rng.randn(1, 4, 64, 8).astype("f"))
+    k = ht.Variable("uf_k", value=rng.randn(1, 4, 64, 8).astype("f"))
+    v = ht.Variable("uf_v", value=rng.randn(1, 4, 64, 8).astype("f"))
+    out = ht.ulysses_attention_op(q, k, v, sm_scale=0.35)
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+    exe = Executor([loss])
+    val = float(exe.run(feed_dict={},
+                        convert_to_numpy_ret_vals=True)[0])
+    assert np.isfinite(val)
