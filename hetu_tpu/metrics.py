@@ -89,7 +89,16 @@ def _threshold_counts(y_score, y_true, thresholds):
     (O(n log n) instead of the reference's O(n*T) tiling,
     metrics.py:17-76 — same counts)."""
     s = np.asarray(y_score, dtype=np.float64).reshape(-1)
-    t = np.asarray(y_true).reshape(-1).astype(bool)
+    t_raw = np.asarray(y_true).reshape(-1)
+    # argument order is (scores, labels) — the reverse of the reference's
+    # (labels, predictions); a swapped call passes continuous scores
+    # here, so insist on binary labels rather than computing garbage
+    if not np.isin(t_raw, (0, 1)).all():
+        raise ValueError(
+            "y_true must be binary 0/1 labels — note hetu_tpu's "
+            "threshold metrics take (y_score, y_true), the reverse of "
+            "the reference's (labels, predictions) order")
+    t = t_raw.astype(bool)
     order = np.argsort(s)
     s_sorted = s[order]
     pos_cum = np.concatenate([[0], np.cumsum(t[order])]).astype(np.float64)

@@ -182,20 +182,18 @@ class _SeqParallelAttentionOp(FlashAttentionOp):
 
     Falls back to the fused single-device path when the session mesh
     has no "sp" axis, so models declare sequence parallelism once and
-    run anywhere. Causal masking is not implemented on the sharded
-    paths (bidirectional-encoder semantics); a causal instance fails
-    fast rather than silently changing numerics with the mesh."""
+    run anywhere. Causal (decoder) masking runs sharded too: the ring
+    routes through the load-balanced zigzag schedule
+    (parallel/ring.py), Ulysses applies the mask blockwise after its
+    heads all-to-all (parallel/ulysses.py)."""
 
     _impl = None            # staticmethod (q, k, v, mesh, axis_name,
-    _cache_prefix = None    #               sm_scale, mask) -> out
+    _cache_prefix = None    #               sm_scale, mask, causal) -> out
 
     def _sharded(self, q, k, v, mask, mesh):
-        if self.causal:
-            raise NotImplementedError(
-                f"{type(self).__name__}: causal masking is not "
-                "supported on the sequence-parallel path")
         return type(self)._impl(q, k, v, mesh, axis_name="sp",
-                                sm_scale=self.sm_scale, mask=mask)
+                                sm_scale=self.sm_scale, mask=mask,
+                                causal=self.causal)
 
     def compute(self, input_vals, ectx):
         mesh = _sp_mesh(ectx)
@@ -237,16 +235,18 @@ class _SeqParallelAttentionGradOp(_FlashAttentionGradOp):
         return ectx.cache[cache_key][self.which]
 
 
-def _ring_impl(q, k, v, mesh, axis_name, sm_scale, mask):
+def _ring_impl(q, k, v, mesh, axis_name, sm_scale, mask, causal=False):
     from ..parallel.ring import ring_attention_sharded
     return ring_attention_sharded(q, k, v, mesh, axis_name=axis_name,
-                                  sm_scale=sm_scale, mask=mask)
+                                  sm_scale=sm_scale, mask=mask,
+                                  causal=causal)
 
 
-def _ulysses_impl(q, k, v, mesh, axis_name, sm_scale, mask):
+def _ulysses_impl(q, k, v, mesh, axis_name, sm_scale, mask, causal=False):
     from ..parallel.ulysses import ulysses_attention_sharded
     return ulysses_attention_sharded(q, k, v, mesh, axis_name=axis_name,
-                                     sm_scale=sm_scale, mask=mask)
+                                     sm_scale=sm_scale, mask=mask,
+                                     causal=causal)
 
 
 class RingAttentionOp(_SeqParallelAttentionOp):
@@ -254,7 +254,8 @@ class RingAttentionOp(_SeqParallelAttentionOp):
     shards over the mesh's "sp" axis and K/V shards rotate around the
     ICI ring with online-softmax merging (parallel/ring.py). Forward AND
     backward run sharded — per-chip attention memory is O(S/n . D), the
-    long-context scaling the reference lacks (SURVEY §5)."""
+    long-context scaling the reference lacks (SURVEY §5). ``causal=True``
+    selects the load-balanced zigzag schedule."""
 
     _impl = staticmethod(_ring_impl)
     _cache_prefix = "ringattn_vjp"
@@ -271,13 +272,15 @@ class UlyssesAttentionOp(_SeqParallelAttentionOp):
     _cache_prefix = "ulyssesattn_vjp"
 
 
-def ring_attention_op(q, k, v, mask=None, sm_scale=1.0, ctx=None):
+def ring_attention_op(q, k, v, mask=None, sm_scale=1.0, causal=False,
+                      ctx=None):
     """Sequence-parallel (ring) attention; see RingAttentionOp."""
-    return RingAttentionOp(q, k, v, mask, sm_scale, causal=False, ctx=ctx)
+    return RingAttentionOp(q, k, v, mask, sm_scale, causal=causal, ctx=ctx)
 
 
-def ulysses_attention_op(q, k, v, mask=None, sm_scale=1.0, ctx=None):
+def ulysses_attention_op(q, k, v, mask=None, sm_scale=1.0, causal=False,
+                         ctx=None):
     """Sequence-parallel (Ulysses all-to-all) attention; see
     UlyssesAttentionOp."""
-    return UlyssesAttentionOp(q, k, v, mask, sm_scale, causal=False,
+    return UlyssesAttentionOp(q, k, v, mask, sm_scale, causal=causal,
                               ctx=ctx)

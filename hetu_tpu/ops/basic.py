@@ -371,8 +371,9 @@ class MatrixDotOp(Op):
 
 
 class CastOp(Op):
-    """Dtype cast (ONNX Cast). Gradient passes through (cast back happens
-    implicitly at the consumer's dtype)."""
+    """Dtype cast (ONNX Cast). Gradient passes through for float->float
+    casts (cast back happens implicitly at the consumer's dtype); casts
+    to integer/bool are non-differentiable and contribute zeros."""
 
     def __init__(self, node_A, dtype, ctx=None):
         super().__init__(CastOp, [node_A], ctx)
@@ -382,6 +383,9 @@ class CastOp(Op):
         return input_vals[0].astype(self.dtype)
 
     def gradient(self, output_grad):
+        if not jnp.issubdtype(self.dtype, jnp.inexact):
+            from .shape import zeroslike_op
+            return [zeroslike_op(self.inputs[0], ctx=self.raw_ctx)]
         return [output_grad]
 
     def infer_shape(self, input_shapes):

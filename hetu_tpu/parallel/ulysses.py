@@ -23,42 +23,49 @@ import functools
 import jax.numpy as jnp
 from jax import lax
 
-from .ring import shard_map_qkv
+from .ring import shard_map_qkv, _partial_attn, _merge
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def _blocked_attention(q, k, v, sm_scale, mask, block=1024):
+def _blocked_attention(q, k, v, sm_scale, mask, block=1024, causal=False):
     """Full-sequence attention via a lax.scan over key blocks with the
-    online-softmax merge (the same rule parallel/ring.py applies across
-    devices, applied locally) — O(S*block) score memory."""
+    online-softmax merge (parallel/ring.py's _partial_attn/_merge,
+    applied locally) — O(S*block) score memory. When S is not a block
+    multiple, K/V pad up to one and the tail is masked out, so the
+    block size (and the memory bound) holds for any length. ``causal``
+    adds the decoder mask per block from global positions (q and k both
+    cover the full sequence here — Ulysses shards heads, not length)."""
     b, h, s, d = q.shape
-    if s % block:
-        block = s                      # odd lengths: single block
-    nblk = s // block
+    block = min(block, s)
+    pad = -s % block
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if mask is None:
+            mask = jnp.zeros((b, 1, 1, s), jnp.float32)
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                       constant_values=-1e9)
+    s_k = s + pad
+    nblk = s_k // block
     kb = k.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
     vb = v.reshape(b, h, nblk, block, d).transpose(2, 0, 1, 3, 4)
     if mask is not None:
         maskb = mask.reshape(b, 1, 1, nblk, block).transpose(3, 0, 1, 2, 4)
     else:
         maskb = jnp.zeros((nblk, 1, 1, 1, block), jnp.float32)
+    starts = jnp.arange(nblk) * block
+    q_pos = jnp.arange(s)
 
     def step(carry, xs):
-        m_acc, l_acc, o_acc = carry
-        k_, v_, mask_ = xs
-        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k_,
-                        preferred_element_type=jnp.float32) * sm_scale
-        sc = sc + mask_
-        m_blk = jnp.max(sc, axis=-1, keepdims=True)
-        p = jnp.exp(sc - m_blk)
-        l_blk = jnp.sum(p, axis=-1, keepdims=True)
-        o_blk = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_.dtype), v_)
-        m_new = jnp.maximum(m_acc, m_blk)
-        a_old = jnp.exp(m_acc - m_new)
-        a_blk = jnp.exp(m_blk - m_new)
-        l_new = l_acc * a_old + l_blk * a_blk
-        o_new = o_acc * a_old + o_blk.astype(jnp.float32) * a_blk
-        return (m_new, l_new, o_new), None
+        k_, v_, mask_, start = xs
+        bias = mask_
+        if causal:
+            k_pos = start + jnp.arange(block)
+            bias = bias + jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                    0.0, -1e9)[None, None]
+        blk = _partial_attn(q, k_, v_, bias, sm_scale)
+        return _merge(carry, blk), None
 
     # init carries derive from q so they inherit its varying-over-mesh
     # type (a fresh constant would be unvarying and shard_map's scan
@@ -66,16 +73,19 @@ def _blocked_attention(q, k, v, sm_scale, mask, block=1024):
     m0 = jnp.zeros_like(q[..., :1], dtype=jnp.float32) - 1e30
     l0 = jnp.zeros_like(q[..., :1], dtype=jnp.float32)
     o0 = jnp.zeros_like(q, dtype=jnp.float32)
-    (m, l, o), _ = lax.scan(step, (m0, l0, o0), (kb, vb, maskb))
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0), (kb, vb, maskb, starts))
     return (o / l).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
+def ulysses_attention(q, k, v, axis_name, sm_scale=1.0, mask=None,
+                      causal=False):
     """Per-shard body (call inside shard_map).
 
     q, k, v: local shards [B, H, S_local, D] (sequence sharded over
     ``axis_name``); mask: optional additive [B, 1, 1, S_local] shard.
-    Non-causal (bidirectional-encoder semantics, like the ring body).
+    ``causal=True`` is the straightforward case for Ulysses: after the
+    all-to-all each device holds the full sequence for its head subset,
+    so the decoder mask applies blockwise from global positions.
     """
     n = lax.psum(1, axis_name)
     h = q.shape[1]
@@ -94,7 +104,7 @@ def ulysses_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
     else:
         mask_full = None
 
-    o = _blocked_attention(q_, k_, v_, sm_scale, mask_full)
+    o = _blocked_attention(q_, k_, v_, sm_scale, mask_full, causal=causal)
 
     # [B, H/n, S, D] -> [B, H, S/n, D]
     return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
@@ -102,9 +112,9 @@ def ulysses_attention(q, k, v, axis_name, sm_scale=1.0, mask=None):
 
 
 def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", sm_scale=1.0,
-                              mask=None):
+                              mask=None, causal=False):
     """shard_map wrapper: q/k/v are global [B, H, S, D]; the sequence
     dim shards over ``axis_name`` of ``mesh``."""
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
-                           sm_scale=sm_scale)
+                           sm_scale=sm_scale, causal=causal)
     return shard_map_qkv(fn, q, k, v, mesh, axis_name, mask=mask)

@@ -81,6 +81,7 @@ class PSRuntime:
         # must see every push before its barrier)
         self._push_pool = None
         self._pending_push = []
+        self.updates_dropped = False   # drain() skipped post-shutdown
         if config.prefetch and not config.bsp:
             from concurrent.futures import ThreadPoolExecutor
             self._push_pool = ThreadPoolExecutor(max_workers=2)
@@ -676,12 +677,16 @@ class PSRuntime:
 
     def drain(self):
         """Block until every in-flight push (sparse ASP pushes, device-
-        cache drains, dense ASP cycles) has reached the server."""
+        cache drains, dense ASP cycles) has reached the server. If the
+        fleet was already stopped, pending updates are dropped and
+        ``self.updates_dropped`` is set so callers (save()) can tell a
+        clean flush from a skipped one (ADVICE r4)."""
         if getattr(self.client, "servers_down", False):
             # the fleet was stopped under us (bench/test teardown
             # ordering): pending updates have nowhere to go — dropping
             # them beats minutes of doomed reconnect retries
             import sys
+            self.updates_dropped = True
             print("[hetu-ps] drain skipped: servers already shut down",
                   file=sys.stderr)
             return
@@ -732,6 +737,12 @@ class PSRuntime:
     def save(self, path):
         import os
         self.drain()
+        if self.updates_dropped:
+            raise RuntimeError(
+                "PS save() after shutdown_servers(): pending updates "
+                "were dropped, a checkpoint now would silently contain "
+                "stale server values (save before shutting the fleet "
+                "down)")
         for cache in self.caches.values():
             cache.flush()       # pending grads reach the server first
         for op_param_id in sorted(self.registered):

@@ -204,3 +204,125 @@ def test_ulysses_fallback_off_mesh():
     val = float(exe.run(feed_dict={},
                         convert_to_numpy_ret_vals=True)[0])
     assert np.isfinite(val)
+
+
+def test_causal_ring_matches_fused():
+    """Causal (decoder) ring attention via the zigzag schedule == fused
+    causal attention, gradients included, with a padding mask."""
+    rng = np.random.RandomState(7)
+    b, h, s, d = 2, 2, 256, 8
+    qv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    kv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    vv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    mv = np.where(rng.rand(b, 1, 1, s) < 0.2, -1e9, 0.0).astype(
+        np.float32)
+
+    def build(op, **kw):
+        q = ht.Variable("cr_q", value=qv)
+        k = ht.Variable("cr_k", value=kv)
+        v = ht.Variable("cr_v", value=vv)
+        m = ht.Variable("cr_m", value=mv, trainable=False)
+        out = op(q, k, v, mask=m, sm_scale=0.35, causal=True, **kw)
+        loss = ht.reduce_mean_op(
+            ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+        train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        return loss, train, (q, k, v)
+
+    loss, train, nodes = build(ht.flash_attention_op)
+    ref = Executor([loss, train])
+    want = [float(ref.run(feed_dict={},
+                          convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    want_k = np.asarray(ref.params[str(nodes[1].id)])
+
+    loss2, train2, nodes2 = build(ht.ring_attention_op)
+    config = HetuConfig(eval_node_list=[loss2, train2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2, train2]}, config=config)
+    got = [float(exe.run(feed_dict={},
+                         convert_to_numpy_ret_vals=True)[0])
+           for _ in range(3)]
+    got_k = np.asarray(exe.params[str(nodes2[1].id)])
+
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(got_k, want_k, rtol=1e-3, atol=1e-5)
+
+
+def test_causal_ulysses_matches_fused():
+    """Causal Ulysses (heads-sharded, blockwise decoder mask) == fused
+    causal attention, gradients included."""
+    rng = np.random.RandomState(8)
+    b, h, s, d = 2, 8, 256, 8
+    qv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    kv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+    vv = rng.randn(b, h, s, d).astype(np.float32) * 0.3
+
+    def build(op):
+        q = ht.Variable("cu_q", value=qv)
+        k = ht.Variable("cu_k", value=kv)
+        v = ht.Variable("cu_v", value=vv)
+        out = op(q, k, v, sm_scale=0.35, causal=True)
+        loss = ht.reduce_mean_op(
+            ht.reduce_sum_op(out * out, [1, 2, 3]), [0])
+        train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+        return loss, train, (q, k, v)
+
+    loss, train, nodes = build(ht.flash_attention_op)
+    ref = Executor([loss, train])
+    want = [float(ref.run(feed_dict={},
+                          convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    want_v = np.asarray(ref.params[str(nodes[2].id)])
+
+    loss2, train2, nodes2 = build(ht.ulysses_attention_op)
+    config = HetuConfig(eval_node_list=[loss2, train2], mesh=_sp_mesh())
+    exe = Executor({"default": [loss2, train2]}, config=config)
+    got = [float(exe.run(feed_dict={},
+                         convert_to_numpy_ret_vals=True)[0])
+           for _ in range(3)]
+    got_v = np.asarray(exe.params[str(nodes2[2].id)])
+
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(got_v, want_v, rtol=1e-3, atol=1e-5)
+
+
+def test_zigzag_indices_roundtrip():
+    """zigzag perm/inv are inverse permutations and shard r gets chunks
+    (r, 2n-1-r)."""
+    from hetu_tpu.parallel.ring import zigzag_indices
+    s, n = 64, 4
+    perm, inv = zigzag_indices(s, n)
+    np.testing.assert_array_equal(perm[inv], np.arange(s))
+    c = s // (2 * n)
+    shard0 = perm[: 2 * c]
+    np.testing.assert_array_equal(
+        shard0, np.concatenate([np.arange(0, c),
+                                np.arange((2 * n - 1) * c, 2 * n * c)]))
+    with pytest.raises(ValueError):
+        zigzag_indices(100, 8)   # 100 % 16 != 0 must fail fast
+
+
+def test_blocked_attention_pads_odd_lengths():
+    """_blocked_attention keeps its block bound for non-multiple S by
+    masked padding (ADVICE r4) — and matches the dense reference."""
+    import jax
+    from hetu_tpu.parallel.ulysses import _blocked_attention
+    from hetu_tpu.ops.attention import attention_reference
+
+    rng = np.random.RandomState(9)
+    b, h, s, d = 1, 2, 300, 8      # 300 % 256 != 0 -> padded tail
+    q = rng.randn(b, h, s, d).astype("f") * 0.3
+    k = rng.randn(b, h, s, d).astype("f") * 0.3
+    v = rng.randn(b, h, s, d).astype("f") * 0.3
+    got = _blocked_attention(jax.numpy.asarray(q), jax.numpy.asarray(k),
+                             jax.numpy.asarray(v), 0.35, None, block=256)
+    want = attention_reference(q, k, v, None, 0.35)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=1e-5)
+    cm = np.where(np.tril(np.ones((s, s), bool)), 0.0,
+                  -1e9)[None, None].astype("f")
+    got_c = _blocked_attention(jax.numpy.asarray(q), jax.numpy.asarray(k),
+                               jax.numpy.asarray(v), 0.35, None,
+                               block=256, causal=True)
+    want_c = attention_reference(q, k, v, cm, 0.35)
+    np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
+                               rtol=2e-4, atol=1e-5)
