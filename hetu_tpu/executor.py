@@ -101,7 +101,8 @@ class HetuConfig:
                  prefetch=True, enable_lazy=False, cache_bound=100,
                  cache_capacity=None, log_path=None, gpipe=False,
                  pipedream=False, dynamic_memory=False, mesh=None,
-                 dtype=None, num_microbatches=None, drain_compress=False):
+                 dtype=None, num_microbatches=None, drain_compress=False,
+                 pipeline_mode=None):
         maybe_init_distributed()
         self.eval_node_list = eval_node_list
         self.train_name = train_name
@@ -119,8 +120,16 @@ class HetuConfig:
         # ps/device_cache.py pad_gather_zero)
         self.drain_compress = drain_compress
         self.log_path = log_path
-        self.use_gpipe = gpipe
+        if pipeline_mode not in (None, "collective"):
+            raise ValueError(
+                f"unknown pipeline_mode {pipeline_mode!r}; expected "
+                "'collective' (one shard_map program over a stage mesh "
+                "axis) or None (staged gpipe/pipedream runners)")
+        self.use_gpipe = gpipe or pipeline_mode == "collective"
         self.use_pipedream = pipedream
+        # "collective": one shard_map program over a stage mesh axis with
+        # ppermute boundary shifts (parallel/collective_pp.py)
+        self.pipeline_mode = pipeline_mode
         self.num_microbatches = num_microbatches
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype
@@ -825,7 +834,10 @@ class Executor:
         self._base_rng = jax.random.PRNGKey(config.seed)
         if config.use_gpipe or config.use_pipedream:
             from .parallel.pipeline import PipelineSubExecutor
-            schedule = "gpipe" if config.use_gpipe else "1f1b"
+            if getattr(config, "pipeline_mode", None) == "collective":
+                schedule = "collective"
+            else:
+                schedule = "gpipe" if config.use_gpipe else "1f1b"
             self.subexecutors = {
                 name: PipelineSubExecutor(
                     name, nodes, config, schedule=schedule,
@@ -892,6 +904,56 @@ class Executor:
             return self.ps_runtime.run_block(
                 sub, feed_dicts, convert_to_numpy_ret_vals)
         return sub.run_block(self, feed_dicts, convert_to_numpy_ret_vals)
+
+    def run_batches_stream(self, blocks, name="default",
+                           convert_to_numpy_ret_vals=False):
+        """run_batches over an iterable of blocks with DOUBLE-BUFFERED
+        feeds: while block i executes on device, a lookahead thread
+        stacks and device-transfers block i+1's plain feeds (the
+        stateless half of the host phase — cache slot assignment stays
+        in order on the caller). On feed-transfer-bound PS configs this
+        hides the H2D behind compute, the same overlap the dataloader
+        prefetch ring gives epoch loops. Returns the last block's
+        results (matching a run_batches loop's final value)."""
+        if name not in self.subexecutors and "default" in self.subexecutors:
+            name = "default"
+        sub = self.subexecutors[name]
+        from .parallel.pipeline import PipelineSubExecutor
+        if isinstance(sub, PipelineSubExecutor):
+            raise ValueError(
+                "run_batches_stream is not supported for pipeline "
+                "executors — the pipeline schedule already amortizes "
+                "dispatch over microbatches; call run() per step")
+        needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
+                    or sub.cached_lookups)
+        blocks = iter(blocks)
+        if not needs_ps or sub.ps_lookups or sub.ps_pull_ops \
+                or sub.ps_ops or self.config.bsp:
+            # host-path PS and BSP fall back to per-step run_step inside
+            # run_block and never read pre_ingested — a lookahead ingest
+            # would transfer every feed twice for nothing
+            out = None
+            for block in blocks:
+                out = self.run_batches(block, name,
+                                       convert_to_numpy_ret_vals)
+            return out
+        from concurrent.futures import ThreadPoolExecutor
+        rt = self.ps_runtime
+        cur = next(blocks, None)
+        if cur is None:
+            return None
+        out = None
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pre = rt.ingest_feeds(sub, cur)
+            while cur is not None:
+                nxt = next(blocks, None)
+                fut = (pool.submit(rt.ingest_feeds, sub, nxt)
+                       if nxt is not None else None)
+                out = rt.run_block(sub, cur, convert_to_numpy_ret_vals,
+                                   pre_ingested=pre)
+                cur = nxt
+                pre = fut.result() if fut is not None else None
+        return out
 
     def get_batch_num(self, name="default"):
         return self.subexecutors[name].batch_num

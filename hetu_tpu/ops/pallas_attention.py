@@ -32,7 +32,11 @@ LANES = 128      # TPU minor-dim tile: residual vectors store lane-tiled
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *, sm_scale,
                 block_k, seq_len, causal, block_q):
-    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    # dots run in the INPUT dtype with f32 accumulation — on bf16 inputs
+    # that is the MXU's native mode; upcasting operands to f32 first
+    # would decompose every matmul into multiple f32 passes (measured
+    # ~2x whole-step cost at S=2048). All softmax math stays f32.
+    q = q_ref[0]                              # [block_q, d]
     num_kb = seq_len // block_k
     qi = pl.program_id(1)
     if causal:
@@ -42,8 +46,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *, sm_scale,
 
     def body(i, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -61,7 +65,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *, sm_scale,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
@@ -198,15 +202,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
                     dk_ref, dv_ref, *, sm_scale, block_q, block_k,
                     seq_len, causal):
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                              # [block_k, d]
+    v = v_ref[0]
     num_qb = seq_len // block_q
     start = (kj * block_k) // block_q if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = l_ref[0, pl.ds(i * block_q, block_q), 0:1][:, 0]
         dd = d_ref[0, pl.ds(i * block_q, block_q), 0:1][:, 0]
         s = jax.lax.dot_general(
@@ -220,16 +224,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])         # [block_q, block_k]
+        p = jnp.exp(s - lse[:, None])         # f32 [block_q, block_k]
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - dd[:, None]) * sm_scale
         dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -243,8 +247,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
                    dq_ref, *, sm_scale, block_q, block_k, seq_len,
                    causal):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                              # [block_q, d]
+    do = do_ref[0]
     lse = l_ref[0, :, 0:1][:, 0]              # [block_q] (lane-tiled in)
     dd = d_ref[0, :, 0:1][:, 0]
     num_kb = seq_len // block_k
@@ -253,8 +257,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
                              pl.cdiv((qi + 1) * block_q, block_k))
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -272,7 +276,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - dd[:, None]) * sm_scale
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros(q.shape, jnp.float32)

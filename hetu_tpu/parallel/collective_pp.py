@@ -1,0 +1,190 @@
+"""Collective (SPMD) pipeline: GPipe as ONE shard_map program.
+
+The staged runners in pipeline.py dispatch per-stage jits and move
+boundaries with device_put (in-process) or the host TCP channel
+(cross-process). This module is the third mode — the whole pipeline is a
+single XLA program over a ``stage`` mesh axis: every device holds one
+stage's parameters (stacked ``[S, ...]`` arrays sharded on the stage
+axis), and each schedule tick shifts the boundary activation to the next
+stage with ``lax.ppermute``, so stage transfers ride ICI with no host in
+the loop at all. The reference moves stage boundaries device-to-device
+over NCCL p2p driven from Python (PipelineSend.py:8-74,
+mpi_nccl_communication.cu:166-230); here the transfer is a compiler-
+scheduled collective inside one jit — zero dispatches per boundary.
+
+Heterogeneous-but-shape-compatible stages dispatch through ``lax.switch``
+on the stage index (each device runs its own stage's subgraph).
+Requirements, checked loudly at build time:
+
+  * a linear chain: stage i consumes exactly one boundary tensor,
+    produced by stage i-1, and all boundary tensors share one
+    shape/dtype;
+  * per-stage parameter lists of matching length and shapes, so
+    position j of every stage stacks into one ``[S, ...]`` array.
+
+That is the shape of every real pipelined model (uniform transformer
+blocks); models that violate it keep the staged runners. The host TCP
+channel (parallel/p2p.py) remains the cross-slice/DCN transport — this
+mode covers the in-slice (single SPMD program) case.
+
+Schedule math matches the staged GPipe runner exactly: microbatch m's
+forward folds the same RNG (step*131 + m), the loss is the mean over
+microbatches, and one optimizer step applies the summed gradients — so
+losses are bit-comparable with pipeline.py's ``_run_gpipe_compiled``
+(tests/test_collective_pp.py asserts it).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["CollectiveGPipe"]
+
+
+def _shard_map():
+    try:
+        from jax import shard_map
+    except ImportError:                   # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+class CollectiveGPipe:
+    """Compiled SPMD GPipe step over a ``stage`` mesh axis.
+
+    branches: list of S callables with the uniform signature
+    ``branch(plist, x, feeds_all, m, rng) -> (boundary_out, loss)`` —
+    plist is the device-local per-position parameter list, x the incoming
+    boundary activation, feeds_all the tuple of every stage's stacked
+    ``[M, mb, ...]`` feeds (branch s reads only feeds_all[s], sliced at
+    microbatch m), and loss a scalar (zero except the last stage).
+    """
+
+    def __init__(self, branches, boundary_aval, num_microbatches, mesh,
+                 axis_name, optimizer):
+        self.branches = branches
+        self.S = len(branches)
+        self.M = num_microbatches
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.optimizer = optimizer
+        self.boundary_aval = boundary_aval
+        self._step = None
+        self._feed_cache = {}     # (stage, j) -> (src array, replicated)
+
+    # -- the per-device schedule body (runs inside shard_map) -----------
+    def _body(self, params_local, feeds_all, base_rng, step):
+        axis = self.axis_name
+        S, M = self.S, self.M
+        r = lax.axis_index(axis)
+        plist = [jnp.squeeze(p, 0) for p in params_local]
+        shift = [(i, i + 1) for i in range(S - 1)]
+        x0 = jnp.zeros(self.boundary_aval.shape, self.boundary_aval.dtype)
+        loss0 = jnp.float32(0.0)
+        if hasattr(lax, "pvary"):
+            # scan carries change varying-over-mesh type inside the loop;
+            # the initial values must already carry it
+            x0 = lax.pvary(x0, (axis,))
+            loss0 = lax.pvary(loss0, (axis,))
+
+        def tick(carry, t):
+            x_cur, loss_acc = carry
+            m = t - r
+            mc = jnp.clip(m, 0, M - 1)
+            rng = jax.random.fold_in(base_rng, step * 131 + mc)
+            y, loss = lax.switch(r, self.branches, plist, x_cur,
+                                 feeds_all, mc, rng)
+            # only the last stage's in-range ticks carry real losses;
+            # out-of-range ticks compute on zeros (their outputs receive
+            # zero cotangents, so they contribute nothing to gradients)
+            valid = (m >= 0) & (m < M) & (r == S - 1)
+            loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
+            if shift:
+                y = lax.ppermute(y, axis, shift)
+            return (y, loss_acc), None
+
+        (x_last, loss_acc), _ = lax.scan(
+            tick, (x0, loss0), jnp.arange(M + S - 1))
+        del x_last
+        return lax.psum(loss_acc, axis) / M
+
+    @staticmethod
+    def _norm_feeds(feeds_all):
+        return tuple(tuple(fs) for fs in feeds_all)
+
+    def build(self, stacked_params, feeds_all):
+        """Jit the full training step (forward schedule + backward +
+        optimizer) with donated param/slot buffers."""
+        from jax.sharding import PartitionSpec as P
+        shard_map = _shard_map()
+        feeds_all = self._norm_feeds(feeds_all)
+        p_specs = tuple(P(self.axis_name) for _ in stacked_params)
+        f_specs = jax.tree_util.tree_map(lambda _: P(), feeds_all)
+        pipeline_loss = shard_map(
+            self._body, mesh=self.mesh,
+            in_specs=(p_specs, f_specs, P(), P()),
+            out_specs=P())
+        opt = self.optimizer
+
+        def train_step(params, opt_state, feeds, base_rng, step, lr):
+            loss, grads = jax.value_and_grad(
+                lambda ps: pipeline_loss(ps, feeds, base_rng, step)
+            )(params)
+            new_p, new_s = [], []
+            for p, g, slots in zip(params, grads, opt_state):
+                # stacked [S, ...] leaves: the optimizers are
+                # elementwise, so one update IS the per-stage update
+                pj, sj = opt.update_one(p, opt._apply_l2(p, g), slots,
+                                        lr, step)
+                new_p.append(pj)
+                new_s.append(sj)
+            return loss, new_p, new_s
+
+        self._step = jax.jit(train_step, donate_argnums=(0, 1))
+        return self._step
+
+    def _replicate(self, feeds_all):
+        """Feeds enter the one SPMD program replicated over the stage
+        mesh (each stage reads only its own slice inside). Identity-
+        cached so pinned feeds transfer once, not once per step."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(self.mesh, P())
+        out = []
+        for s, fs in enumerate(feeds_all):
+            row = []
+            for j, f in enumerate(fs):
+                key = (s, j)
+                hit = self._feed_cache.get(key)
+                if hit is not None and hit[0] is f:
+                    row.append(hit[1])
+                    continue
+                fr = jax.device_put(f, sh)
+                self._feed_cache[key] = (f, fr)
+                row.append(fr)
+            out.append(tuple(row))
+        return tuple(out)
+
+    def step(self, stacked_params, opt_state, feeds_all, base_rng, step,
+             lr):
+        if self._step is None:
+            self.build(stacked_params, feeds_all)
+        return self._step(tuple(stacked_params), tuple(opt_state),
+                          self._replicate(feeds_all),
+                          base_rng, jnp.int32(step), jnp.float32(lr))
+
+    # -- placement helpers ----------------------------------------------
+    def place_stacked(self, arrs_by_stage):
+        """Stack per-stage host/device arrays into [S, ...] sharded over
+        the stage axis."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(self.mesh, P(self.axis_name))
+        out = []
+        nper = len(arrs_by_stage[0])
+        for j in range(nper):
+            stacked = np.stack([np.asarray(arrs_by_stage[s][j])
+                                for s in range(self.S)])
+            out.append(jax.device_put(stacked, sh))
+        return out

@@ -225,6 +225,9 @@ class PipelineSubExecutor:
         self._losses_ema = None
         self._fused_step = None   # whole-step jit when stages co-reside
         self._feed_cache = {}     # (stage, node) -> (src jax.Array, stacked)
+        self._cpp = None          # CollectiveGPipe (schedule="collective")
+        self._cpp_params = None   # stacked [S, ...] param leaves
+        self._cpp_slots = None    # stacked optimizer slots per position
 
     # ------------------------------------------------------------------
     def _build_stages(self, topo):
@@ -672,9 +675,13 @@ class PipelineSubExecutor:
             per_stage.append(feeds_m)
         return per_stage
 
-    def _stack_feeds(self, feed_dict, m_total):
+    def _stack_feeds(self, feed_dict, m_total, place=True):
         """Global batch -> per-stage [M, mb, ...] stacked feeds, one
-        device transfer per feed node per step (GPipe compiled path)."""
+        device transfer per feed node per step (GPipe compiled path).
+        ``place=False`` skips the per-stage device placement — the
+        collective mode replicates feeds over its own mesh instead, and
+        placing them on a stage device first would double the
+        host->device traffic."""
         per_stage = []
         for stage in self.stages:
             vals = []
@@ -697,18 +704,28 @@ class PipelineSubExecutor:
                     if hit is not None and hit[0] is v:
                         vals.append(hit[1])
                         continue
-                    stacked = stage.put(
-                        jnp.reshape(v[:mb * m_total], stacked_shape))
+                    stacked = jnp.reshape(v[:mb * m_total], stacked_shape)
+                    if place:
+                        stacked = stage.put(stacked)
                     self._feed_cache[ck] = (v, stacked)
                 else:
-                    stacked = stage.put(
-                        v[:mb * m_total].reshape(stacked_shape))
+                    stacked = v[:mb * m_total].reshape(stacked_shape)
+                    if place:
+                        stacked = stage.put(stacked)
                 vals.append(stacked)
             per_stage.append(vals)
         return per_stage
 
     # ------------------------------------------------------------------
     def run(self, executor, feed_dict=None, convert_to_numpy_ret_vals=False):
+        if self.schedule == "collective":
+            feed_dict = feed_dict or {}
+            loss = self._run_collective(
+                executor, self._stack_feeds(feed_dict,
+                                            self.num_microbatches,
+                                            place=False))
+            return self._finish_step(executor, loss,
+                                     convert_to_numpy_ret_vals)
         if not self.stages[0].params and not any(
                 s.params for s in self.stages):
             self._place_params(executor)
@@ -730,7 +747,10 @@ class PipelineSubExecutor:
             feeds = self._split_feeds(feed_dict, M)
             losses = self._run_1f1b(executor, feeds, M)
             loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
-        # the LR scheduler advances once per GLOBAL step under both
+        return self._finish_step(executor, loss, convert_to_numpy_ret_vals)
+
+    def _finish_step(self, executor, loss, convert_to_numpy_ret_vals):
+        # the LR scheduler advances once per GLOBAL step under all
         # schedules (pinned semantics; see module docstring)
         self.optimizer.lr_sched.step()
         self.step_count += 1
@@ -828,6 +848,165 @@ class PipelineSubExecutor:
             self._commit_stage_update(executor, stage, new_params,
                                       new_state)
         return loss_mean
+
+    # ------------------------------------------------------------------
+    def _build_collective(self, executor, stacked_feeds):
+        """Lower the stage graph onto one SPMD program (collective_pp.py):
+        validate the linear-chain/homogeneity contract, build uniform
+        switch branches from the per-stage subgraph functions, stack
+        params and optimizer slots over the stage axis."""
+        from jax.sharding import Mesh
+        from .collective_pp import CollectiveGPipe
+
+        stages = self.stages
+        S = len(stages)
+        if self.multiproc:
+            raise ValueError(
+                "pipeline_mode='collective' is the in-slice SPMD mode; "
+                "stages spanning worker processes keep the staged "
+                "runners (the p2p channel is the DCN transport)")
+        if S < 2:
+            raise ValueError(
+                "pipeline_mode='collective' needs >= 2 stages (wrap "
+                "layer blocks in distinct ht.context(...) scopes)")
+        devs = [s.device for s in stages]
+        if len(set(devs)) != S:
+            raise ValueError(
+                "pipeline_mode='collective' needs one distinct device "
+                f"per stage; got {devs} — on a single chip use the "
+                "staged/fused runners instead")
+        if any(s.mesh is not None for s in stages):
+            raise ValueError(
+                "pipeline_mode='collective' does not compose with "
+                "in-stage TP/DP meshes yet; use the staged runners")
+        loss_stage = self.assign[self.loss_node]
+        if loss_stage != S - 1:
+            raise ValueError(
+                f"collective pipeline expects the loss on the last "
+                f"stage (found on stage {loss_stage})")
+        for i, st in enumerate(stages):
+            if i == 0 and st.in_nodes:
+                raise ValueError("stage 0 must not consume boundaries")
+            if i > 0 and (len(st.in_nodes) != 1 or
+                          self.assign[st.in_nodes[0]] != i - 1):
+                raise ValueError(
+                    f"collective pipeline needs a linear chain with one "
+                    f"boundary tensor per stage; stage {i} consumes "
+                    f"{[(n.name, self.assign[n]) for n in st.in_nodes]}")
+            if i < S - 1 and len(st.consumed_outs) != 1:
+                raise ValueError(
+                    f"stage {i} must export exactly one boundary tensor "
+                    f"(got {len(st.consumed_outs)})")
+        shapes0 = [np.shape(executor.params[str(p.id)])
+                   for p in stages[0].param_nodes]
+        for st in stages[1:]:
+            shp = [np.shape(executor.params[str(p.id)])
+                   for p in st.param_nodes]
+            if shp != shapes0:
+                raise ValueError(
+                    "collective pipeline needs homogeneous stages: "
+                    f"stage {st.index} params {shp} != stage 0 "
+                    f"{shapes0} — make the stage blocks uniform or use "
+                    "the staged runners")
+
+        machinery = [self._stage_machinery(st)[0] for st in stages]
+        # boundary aval: trace the stage chain abstractly once
+        rng_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        b_aval = None
+        for i, st in enumerate(stages):
+            p_avals = {str(p.id): jax.ShapeDtypeStruct(
+                np.shape(executor.params[str(p.id)]),
+                executor.params[str(p.id)].dtype)
+                for p in st.param_nodes}
+            f_avals = [jax.ShapeDtypeStruct(f.shape[1:], f.dtype)
+                       for f in stacked_feeds[i]]
+            ins = [b_aval] if st.in_nodes else []
+            outs = jax.eval_shape(machinery[i], p_avals, ins, f_avals,
+                                  rng_aval)
+            if i < S - 1:
+                out_aval = outs[st.out_nodes.index(st.consumed_outs[0])]
+                if b_aval is not None and (
+                        out_aval.shape != b_aval.shape
+                        or out_aval.dtype != b_aval.dtype):
+                    raise ValueError(
+                        "collective pipeline needs one uniform boundary "
+                        f"shape; stage {i} emits {out_aval} after "
+                        f"{b_aval}")
+                b_aval = out_aval
+
+        loss_node = self.loss_node
+
+        def make_branch(s):
+            st = stages[s]
+            stage_fn = machinery[s]
+            pnodes = list(st.param_nodes)
+
+            def branch(plist, x, feeds_all, m, rng):
+                params = {str(n.id): v for n, v in zip(pnodes, plist)}
+                feeds = [jnp.take(f, m, axis=0) for f in feeds_all[s]]
+                ins = [x] if st.in_nodes else []
+                outs = stage_fn(params, ins, feeds, rng)
+                if s < S - 1:
+                    y = outs[st.out_nodes.index(st.consumed_outs[0])]
+                    # zero loss derived from y so every branch's outputs
+                    # share the same varying-over-mesh type (shard_map
+                    # rejects mixed unvarying/varying switch branches)
+                    return y, (jnp.mean(y) * 0.0).astype(jnp.float32)
+                loss = outs[st.out_nodes.index(loss_node)]
+                loss = jnp.mean(loss).astype(jnp.float32)
+                y = jnp.zeros(b_aval.shape, b_aval.dtype) + \
+                    (loss * 0.0).astype(b_aval.dtype)
+                return y, loss
+
+            return branch
+
+        mesh = Mesh(np.asarray(devs), axis_names=("stage",))
+        cpp = CollectiveGPipe([make_branch(s) for s in range(S)],
+                              b_aval, self.num_microbatches, mesh,
+                              "stage", self.optimizer)
+        self._cpp = cpp
+        self._cpp_params = cpp.place_stacked(
+            [[executor.params[str(p.id)] for p in st.param_nodes]
+             for st in stages])
+        # stacked optimizer slots per position (same elementwise update)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P("stage"))
+        slots = []
+        full = executor.opt_state or {}
+        for j, p0 in enumerate(stages[0].param_nodes):
+            keys = sorted(full.get(p0.id, {}))
+            slots.append({
+                k: jax.device_put(np.stack(
+                    [np.asarray(full[st.param_nodes[j].id][k])
+                     for st in stages]), sh)
+                for k in keys})
+        self._cpp_slots = slots
+
+    def _run_collective(self, executor, stacked_feeds):
+        if self._cpp is None:
+            self._build_collective(executor, stacked_feeds)
+            # ONE jitted unstack for the whole write-back (S*P*slots
+            # individual slice dispatches per step would re-introduce
+            # the host-dispatch overhead this mode exists to remove)
+            self._cpp_unstack = jax.jit(
+                lambda ps, ss: (
+                    [[p[s] for p in ps] for s in range(len(self.stages))],
+                    [[{k: v[s] for k, v in slot.items()} for slot in ss]
+                     for s in range(len(self.stages))]))
+        loss, new_p, new_s = self._cpp.step(
+            self._cpp_params, self._cpp_slots, stacked_feeds,
+            executor.base_rng, self.step_count,
+            self.optimizer.learning_rate)
+        self._cpp_params, self._cpp_slots = new_p, new_s
+        # async write-back so save()/tests read fresh values (no host
+        # sync: the unstacked views materialize on demand)
+        per_stage_p, per_stage_s = self._cpp_unstack(new_p, new_s)
+        for s, st in enumerate(self.stages):
+            for j, p in enumerate(st.param_nodes):
+                executor.params[str(p.id)] = per_stage_p[s][j]
+                if per_stage_s[s][j]:
+                    executor.opt_state[p.id] = per_stage_s[s][j]
+        return loss
 
     def _run_gpipe_multiproc(self, executor, stacked_feeds, M):
         """GPipe with stages spanning worker processes: each rank runs

@@ -95,7 +95,8 @@ class PSRuntime:
         # step-phase timing (VERDICT: make the residual gap attributable)
         self.times = {"slot_assign": 0.0, "miss_fill": 0.0, "refresh": 0.0,
                       "dispatch": 0.0, "drain_submit": 0.0, "dense": 0.0,
-                      "host_pull": 0.0, "sync_push": 0.0}
+                      "host_pull": 0.0, "sync_push": 0.0,
+                      "feed_ingest": 0.0}
         self._closed = False
         # eager registration so save()/load() work before the first step
         self._register_all()
@@ -432,14 +433,34 @@ class PSRuntime:
         return results
 
     # ------------------------------------------------------------------
-    def run_block(self, sub, feed_dicts, convert_to_numpy_ret_vals=False):
+    def ingest_feeds(self, sub, feed_dicts):
+        """Stack + device-transfer a block's plain feeds (the stateless
+        part of run_block's host phase). Safe to run on a lookahead
+        thread while the previous block executes — the stateful work
+        (cache slot assignment, miss fills) stays on the caller. Returns
+        the {node: (stacked, first_row)} map run_block accepts as
+        ``pre_ingested``."""
+        topo_set = getattr(sub, "_topo_set", None)
+        if topo_set is None:
+            topo_set = sub._topo_set = set(sub.topo_order)
+        out = {}
+        for node in (feed_dicts[0] or {}):
+            if node not in topo_set:
+                continue     # e.g. raw ids replaced by the slots feed
+            out[node] = sub._stack_feed([fd[node] for fd in feed_dicts])
+        return out
+
+    def run_block(self, sub, feed_dicts, convert_to_numpy_ret_vals=False,
+                  pre_ingested=None):
         """``len(feed_dicts)`` steps in ONE dispatch for device-cached
         graphs: slots for every step are assigned up front (misses fill
         before the block; pins persist across the whole block so no
         in-block row is evicted), feeds stack into single transfers, and
         the compiled lax.scan runs the steps back-to-back on device.
         Falls back to per-step run_step for host-path PS graphs and BSP
-        (whose barrier is per-step by definition)."""
+        (whose barrier is per-step by definition). ``pre_ingested``
+        (from ingest_feeds, possibly on a lookahead thread) skips the
+        in-line feed stacking — the double-buffered input path."""
         if (sub.ps_lookups or sub.ps_pull_ops or sub.ps_ops
                 or self.config.bsp):
             return [self.run_step(sub, fd, convert_to_numpy_ret_vals)
@@ -456,16 +477,15 @@ class PSRuntime:
                     executor.params[sid] = jax.device_put(
                         value.reshape(param.shape))
 
-        topo_set = getattr(sub, "_topo_set", None)
-        if topo_set is None:
-            topo_set = sub._topo_set = set(sub.topo_order)
+        t0 = time.perf_counter()
+        ingested = (pre_ingested if pre_ingested is not None
+                    else self.ingest_feeds(sub, feed_dicts))
         feed_map = {}
         first_map = {}
-        for node in (feed_dicts[0] or {}):
-            if node not in topo_set:
-                continue     # e.g. raw ids replaced by the slots feed
-            feed_map[node], first_map[node] = sub._stack_feed(
-                [fd[node] for fd in feed_dicts])
+        for node, (stacked, first) in ingested.items():
+            feed_map[node] = stacked
+            first_map[node] = first
+        self.times["feed_ingest"] += time.perf_counter() - t0
         for dl in sub.dataloader_ops:
             stacked = np.stack(sub.dl_block(dl, nsteps))
             feed_map[dl] = sub._ingest_stacked(stacked)

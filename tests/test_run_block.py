@@ -177,3 +177,43 @@ def test_ps_block_eviction_matches_sequential(ps_env):
     assert rt.evicts > 0
     exe2.close()
     np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_ps_stream_matches_run_batches(ps_env):
+    """run_batches_stream (double-buffered feed ingest on a lookahead
+    thread) trains identically to sequential run_batches on the
+    device-cache path — the overlap must not reorder stateful work."""
+    rng = np.random.RandomState(3)
+    table = rng.randn(60, 4).astype(np.float32)
+    data = [(rng.randint(0, 60, (8, 3)),
+             rng.randn(8, 2).astype(np.float32)) for _ in range(12)]
+    blocks = [data[:4], data[4:8], data[8:]]
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=5)
+    for chunk in blocks:
+        out = exe.run_batches([{ids: i, y_: y} for i, y in chunk],
+                              convert_to_numpy_ret_vals=True)
+    want_last = float(out[-1][0])
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    exe.ps_runtime.drain()
+    want_cache = np.asarray(exe.params[rt.cache_sid]).copy()
+    want_ids = rt.id_of.copy()
+    exe.close()
+
+    ids2, y2, loss2, train2 = _embed_model(table)
+    exe2 = Executor([loss2, train2], comm_mode="PS",
+                    cstable_policy="Device", cache_bound=5)
+    out2 = exe2.run_batches_stream(
+        ([{ids2: i, y2: y} for i, y in chunk] for chunk in blocks),
+        convert_to_numpy_ret_vals=True)
+    got_last = float(out2[-1][0])
+    rt2 = next(iter(exe2.ps_runtime.device_tables.values()))
+    exe2.ps_runtime.drain()
+    got_cache = np.asarray(exe2.params[rt2.cache_sid])
+    np.testing.assert_allclose(got_last, want_last, rtol=1e-5)
+    np.testing.assert_array_equal(rt2.id_of, want_ids)
+    np.testing.assert_allclose(got_cache, want_cache, rtol=1e-5)
+    assert exe2.ps_runtime.times["feed_ingest"] >= 0.0
+    exe2.close()
