@@ -23,10 +23,10 @@ logging.basicConfig(level=logging.INFO,
                     format="%(asctime)s - %(name)s - %(message)s")
 logger = logging.getLogger("hetu.examples.cnn")
 
-MODELS = ["alexnet", "cnn_3_layers", "lenet", "logreg", "lstm", "mlp",
-          "resnet18", "resnet34", "rnn", "vgg16", "vgg19"]
-CONV_MODELS = {"alexnet", "cnn_3_layers", "lenet", "resnet18", "resnet34",
-               "vgg16", "vgg19"}
+MODELS = ["alexnet", "cnn_3_layers", "digits_cnn", "lenet", "logreg",
+          "lstm", "mlp", "resnet18", "resnet34", "rnn", "vgg16", "vgg19"]
+CONV_MODELS = {"alexnet", "cnn_3_layers", "digits_cnn", "lenet",
+               "resnet18", "resnet34", "vgg16", "vgg19"}
 
 
 def build_optimizer(name, lr):
@@ -51,9 +51,10 @@ def load_dataset(name, model):
             tx = tx.reshape(-1, 1, 28, 28)
             vx = vx.reshape(-1, 1, 28, 28)
     elif name == "DIGITS":
-        # the checked-in real shard (hetu_tpu/data.py digits()) — dense
-        # models only (8x8 images are below the conv stacks' geometry)
-        assert not conv, "DIGITS supports dense models (logreg/mlp)"
+        # the checked-in real shard (hetu_tpu/data.py digits()); conv
+        # path is digits_cnn (8x8 geometry — the 28x28 stacks don't fit)
+        assert not conv or model == "digits_cnn", \
+            "DIGITS supports logreg/mlp/digits_cnn (8x8 images)"
         (tx, ty), (vx, vy), _ = ht.data.digits()
     elif name in ("CIFAR10", "CIFAR100"):
         loader = ht.data.cifar10 if name == "CIFAR10" else ht.data.cifar100

@@ -1,0 +1,20 @@
+#!/bin/bash
+# CNN parity sweep (reference parity: all_cnn_tests.sh): the conv model
+# under every dispatch split must reproduce the single-device base loss
+# series. Hermetic form — 8 virtual CPU devices; drop the two exports
+# to run on real TPU chips.
+set -e
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+HETURUN=../../../bin/heturun
+mkdir -p results
+
+$HETURUN -c config1.yml python test_cnn_base.py --save --log results/base.npy
+
+$HETURUN -c config2.yml python test_cnn_mp.py --split left   --log results/res0.npy
+$HETURUN -c config2.yml python test_cnn_mp.py --split middle --log results/res1.npy
+$HETURUN -c config2.yml python test_cnn_mp.py --split right  --log results/res2.npy
+
+python validate_results.py 3
+echo "all CNN parallel configs match the base loss series"

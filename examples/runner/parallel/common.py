@@ -61,8 +61,43 @@ def ensure_std(force=False):
             np.save(path, (rng.randn(*shape) * 0.05).astype(np.float32))
 
 
+def ensure_cnn_std(force=False):
+    """Fixed weights for the CNN zoo variants (reference
+    all_cnn_tests.sh trains the same conv model under every split)."""
+    os.makedirs(STD, exist_ok=True)
+    rng = np.random.RandomState(43)
+    specs = {
+        "cnn_conv1_weight": (32, 1, 5, 5),
+        "special_cnn_weight": (32, 32, 5, 5),
+        "cnn_fc_weight": (32 * 7 * 7, 10),
+        "cnn_fc_bias": (10,),
+    }
+    for name, shape in specs.items():
+        path = os.path.join(STD, name + ".npy")
+        if force or not os.path.exists(path):
+            np.save(path, (rng.randn(*shape) * 0.04).astype(np.float32))
+
+
+def conv_relu(x, name, ctx=None):
+    """5x5/pad2 conv + relu from fixed std/ weights (reference
+    test_model_cnn_base.py conv_relu)."""
+    w = ht.Variable(name, value=load_std(name), ctx=ctx)
+    return ht.relu_op(ht.conv2d_op(x, w, padding=2, stride=1))
+
+
 def load_std(name):
     return np.load(os.path.join(STD, name + ".npy"))
+
+
+# conv split vocabulary -> (data parts, filter parts) over NCHW x OIHW
+# (reference test_model_cnn.py --split): 'left' batch-splits the data,
+# 'right' splits the filter's output channels, 'middle' splits the
+# contracted input channels on both operands
+CNN_SPLITS = {
+    "left": ((2, 1, 1, 1), (1, 1, 1, 1)),
+    "right": ((1, 1, 1, 1), (2, 1, 1, 1)),
+    "middle": ((1, 2, 1, 1), (1, 2, 1, 1)),
+}
 
 
 def fc(x, name, with_relu=True, ctx=None):

@@ -1,0 +1,59 @@
+"""Two-host data parallelism through the launcher's ssh path (reference
+examples/runner/parallel/dist_data_pipeline_mlp.py + dist_config8.yml):
+each host contributes one SPMD worker process; the dp mesh spans both
+processes and gradients AllReduce over it, so the loss series matches
+the single-device base run bit-for-bit.
+
+    heturun -c dist_config2.yml python dist_data_mlp.py --log res.npy
+"""
+import argparse
+import os
+
+# one device per worker process: the 2-process dp mesh is exactly the
+# two hosts (set before jax initializes via common's import)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np                                      # noqa: E402
+
+import common                                           # noqa: E402
+import hetu_tpu as ht                                   # noqa: E402
+from hetu_tpu.executor import (Executor, HetuConfig,    # noqa: E402
+                               maybe_init_distributed)
+
+
+def main(args):
+    maybe_init_distributed()     # joins the 2-process JAX job
+    import jax
+    from jax.sharding import Mesh
+    assert jax.process_count() == 2, jax.process_count()
+    common.ensure_std()
+    x = ht.Variable("dataloader_x", trainable=False)
+    act = common.fc(x, "mlp_fc1", with_relu=True)
+    w = ht.Variable("special_weight",
+                    value=common.load_std("special_weight"))
+    act = ht.relu_op(ht.matmul_op(act, w))
+    y_pred = common.fc(act, "mlp_fc2", with_relu=False)
+    y_ = ht.Variable("dataloader_y", trainable=False)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(y_pred, y_), [0])
+    train_op = ht.optim.SGDOptimizer(
+        learning_rate=args.learning_rate).minimize(loss)
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    config = HetuConfig(eval_node_list=[loss, train_op], mesh=mesh)
+    config.nrank = jax.process_count()
+    executor = Executor({"default": [loss, train_op]}, config=config)
+    log = args.log
+    if log and int(os.environ.get("HETU_PROC_ID", "0")) != 0:
+        log = None               # rank 0 writes the comparison artifact
+    common.train_and_log(executor, x, y_, args.steps, log,
+                         batch_size=args.batch_size)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--learning-rate", type=float, default=0.01)
+    parser.add_argument("--log", default=None)
+    main(parser.parse_args())

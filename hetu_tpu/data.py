@@ -47,9 +47,57 @@ def synthetic(n, x_shape, num_classes, seed=0, onehot=True):
     return x, y.astype(np.float32)
 
 
+def _warn_synthetic(name):
+    """Synthesizing a stand-in must be LOUD (VERDICT r4: silent
+    synthesis made accuracy claims ambiguous). HETU_REQUIRE_REAL_DATA=1
+    turns it into an error for accuracy work."""
+    import sys
+    if os.environ.get("HETU_REQUIRE_REAL_DATA", "0") == "1":
+        raise FileNotFoundError(
+            f"{name}: real dataset files not found under {_data_dir()} "
+            "and HETU_REQUIRE_REAL_DATA=1 — drop the files in (see "
+            "hetu_tpu/data.py loaders for accepted formats) or unset "
+            "the flag")
+    print(f"[hetu-data] {name}: real files not found under "
+          f"{_data_dir()}; using a DETERMINISTIC SYNTHETIC stand-in "
+          "(shapes/dtypes match; accuracies are not comparable to "
+          "published numbers)", file=sys.stderr)
+
+
+def _load_idx(path):
+    """Read an MNIST IDX (ubyte) file, gzipped or not — the format the
+    reference's loader downloads (reference data.py:5-44)."""
+    import struct
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        if magic[0] != 0:
+            raise ValueError(f"{path}: not an IDX file")
+        if magic[1] != 0x08:
+            raise ValueError(
+                f"{path}: IDX dtype code 0x{magic[1]:02x} is not ubyte "
+                "(0x08) — MNIST files are ubyte; refusing to reinterpret")
+        ndim = magic[2]
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find_idx(stem):
+    for suffix in ("", ".gz"):
+        p = os.path.join(_data_dir(), stem + suffix)
+        if os.path.exists(p):
+            return p
+    return None
+
+
 def mnist(dataset="mnist.pkl.gz", onehot=True):
     """Returns [(train_x, train_y), (valid_x, valid_y), (test_x, test_y)]
-    with x flattened to 784 (reference data.py:5-44)."""
+    with x flattened to 784 (reference data.py:5-44). Accepts either the
+    pickled ``mnist.pkl.gz`` or the standard IDX files
+    (``train-images-idx3-ubyte[.gz]`` etc.) in HETU_DATA_DIR; with
+    neither present, synthesizes a stand-in LOUDLY (stderr, or an error
+    under HETU_REQUIRE_REAL_DATA=1)."""
     path = os.path.join(_data_dir(), dataset)
     if os.path.exists(path):
         with gzip.open(path, "rb") as f:
@@ -60,6 +108,22 @@ def mnist(dataset="mnist.pkl.gz", onehot=True):
             y = convert_to_one_hot(y, 10) if onehot else y
             return x.astype(np.float32), y
         return [prep(train_set), prep(valid_set), prep(test_set)]
+    ti = _find_idx("train-images-idx3-ubyte")
+    tl = _find_idx("train-labels-idx1-ubyte")
+    vi = _find_idx("t10k-images-idx3-ubyte")
+    vl = _find_idx("t10k-labels-idx1-ubyte")
+    if ti and tl and vi and vl:
+        tx = _load_idx(ti).reshape(-1, 784).astype(np.float32) / 255.0
+        ty = _load_idx(tl)
+        sx = _load_idx(vi).reshape(-1, 784).astype(np.float32) / 255.0
+        sy = _load_idx(vl)
+        n = max(1, len(tx) - len(tx) // 6)     # carve a validation split
+        vx, vy = tx[n:], ty[n:]
+        tx, ty = tx[:n], ty[:n]
+        if onehot:
+            ty, vy, sy = (convert_to_one_hot(a, 10) for a in (ty, vy, sy))
+        return [(tx, ty), (vx, vy), (sx, sy)]
+    _warn_synthetic("mnist")
     tx, ty = synthetic(10000, (784,), 10, seed=1, onehot=onehot)
     vx, vy = synthetic(2000, (784,), 10, seed=2, onehot=onehot)
     sx, sy = synthetic(2000, (784,), 10, seed=3, onehot=onehot)
@@ -103,6 +167,7 @@ def _cifar(directory, num_class, onehot):
             y = convert_to_one_hot(y, num_class)
         n = len(x) * 5 // 6
         return (x[:n], y[:n]), (x[n:], y[n:])
+    _warn_synthetic(directory)
     tx, ty = synthetic(10000, (3, 32, 32), num_class, seed=4, onehot=onehot)
     vx, vy = synthetic(2000, (3, 32, 32), num_class, seed=5, onehot=onehot)
     return (tx, ty), (vx, vy)

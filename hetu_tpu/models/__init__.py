@@ -8,8 +8,8 @@ examples/ctr/models/ (WDL, DeepFM, DCN, DC), examples/rec/hetu_ncf.py
 placeholder nodes and returns (loss, y) graph nodes, exactly like the
 reference's ``model(x, y_)`` convention.
 """
-from .cnn import (logreg, mlp, cnn_3_layers, lenet, alexnet, vgg16, vgg19,
-                  resnet18, resnet34, rnn, lstm)
+from .cnn import (logreg, mlp, cnn_3_layers, digits_cnn, lenet, alexnet,
+                  vgg16, vgg19, resnet18, resnet34, rnn, lstm)
 from .bert import (BertConfig, BertModel, BertForPreTraining,
                    BertForSequenceClassification, BertForMaskedLM)
 from .ctr import (wdl_criteo, wdl_adult, deepfm_criteo, dcn_criteo,

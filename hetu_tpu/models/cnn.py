@@ -90,6 +90,26 @@ def cnn_3_layers(x, y_):
     return loss, y
 
 
+def digits_cnn(x, y_):
+    """Conv net for the checked-in REAL 8x8 digit images (ht.data.digits):
+    32f3 -> pool -> 64f3 -> pool -> fc. The real-image conv accuracy
+    workload this environment can run with zero network egress (full
+    MNIST would need the IDX files dropped into HETU_DATA_DIR — the
+    loader supports them, data.py:mnist)."""
+    x = array_reshape_op(x, (-1, 1, 8, 8))
+    x = conv2d(x, 1, 32, kernel=3, padding=1, name="dcnn_conv1")
+    x = relu_op(x)
+    x = max_pool2d_op(x, 2, 2, stride=2)
+    x = conv2d(x, 32, 64, kernel=3, padding=1, name="dcnn_conv2")
+    x = relu_op(x)
+    x = max_pool2d_op(x, 2, 2, stride=2)
+    x = array_reshape_op(x, (-1, 2 * 2 * 64))
+    x = fc(x, (2 * 2 * 64, 128), "dcnn_fc1")
+    y = fc(x, (128, 10), "dcnn_fc2", with_relu=False)
+    loss = reduce_mean_op(softmaxcrossentropy_op(y, y_), [0])
+    return loss, y
+
+
 def lenet(x, y_):
     """LeNet-5 on MNIST (reference models/LeNet.py)."""
     x = array_reshape_op(x, (-1, 1, 28, 28))

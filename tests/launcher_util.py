@@ -1,0 +1,27 @@
+"""Shared scaffolding for tests that spawn heturun fleets: a clean
+launcher environment (fresh coordinator/p2p ports, no PS/SPMD state
+leaked from an outer run). One definition — the env-var scrub list must
+stay identical across every launcher-driven test."""
+import os
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+# env a previous fleet (or the surrounding pytest process) may have
+# exported; a leaked value silently rewires the next fleet
+_FLEET_VARS = ("HETU_PS_HOSTS", "HETU_PS_PORTS", "HETU_COORDINATOR",
+               "HETU_NUM_PROCS", "HETU_PROC_ID")
+
+
+def clean_launcher_env(**extra):
+    """os.environ minus leaked fleet state, plus fresh coordinator and
+    pipe-channel ports and the repo on PYTHONPATH."""
+    from hetu_tpu.ps.server import pick_free_port
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "HETU_COORDINATOR_PORT": str(pick_free_port()),
+           "HETU_PIPE_BASE_PORT": str(pick_free_port())}
+    for k in _FLEET_VARS:
+        env.pop(k, None)
+    env.update(extra)
+    return env
