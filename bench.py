@@ -132,6 +132,27 @@ def emit(metric, value, unit, vs, **extra):
     print(json.dumps(rec), flush=True)
 
 
+def h2d_probe_mbps(nbytes=8 << 20, reps=3):
+    """Measured host->device throughput at bench time, in MEGABYTES/s
+    (emitted as ``h2d_MBps``; device_put of an nbytes array, readback-
+    synced). The WDL/NCF feeds are H2D-bound on this remote-tunnel link
+    and its speed swings run to run — recording the probe beside the
+    metric makes a slow window attributable to the link instead of a
+    silent regression."""
+    import jax
+    import jax.numpy as jnp
+    buf = np.random.RandomState(0).randn(nbytes // 4).astype(np.float32)
+    times = []
+    for i in range(reps + 1):
+        src = buf + np.float32(i)        # defeat any transfer caching
+        t0 = time.perf_counter()
+        x = jax.device_put(src)
+        float(jnp.sum(x))                # force completion via readback
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times[1:]))     # first rep warms the path
+    return nbytes / dt / 1e6
+
+
 def _pin(feeds):
     """Feed dict -> device-resident values, transferred once (a training
     loop's input pipeline overlaps transfers; the bench pins instead —
@@ -313,6 +334,7 @@ def bench_wdl_ps():
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
+             h2d_MBps=h2d_probe_mbps(),
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()     # drain before the finally block kills the server
     finally:
@@ -373,6 +395,7 @@ def bench_wdl_hybrid():
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
+             h2d_MBps=h2d_probe_mbps(),
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:

@@ -45,6 +45,8 @@ def load_dataset(name, model):
     """(train_x, train_y, val_x, val_y); images are NCHW for conv nets,
     flat for dense nets (reference main.py's per-model reshapes)."""
     conv = model in CONV_MODELS
+    assert model != "digits_cnn" or name == "DIGITS", \
+        "digits_cnn is the 8x8-geometry conv net: use --dataset DIGITS"
     if name == "MNIST":
         (tx, ty), (vx, vy), _ = ht.data.mnist()
         if conv:

@@ -16,8 +16,9 @@ from ..ops import (add_op, array_reshape_op, avg_pool2d_op,
                    pad_op, reduce_mean_op, relu_op, sigmoid_op, slice_op,
                    softmaxcrossentropy_op, tanh_op)
 
-__all__ = ["logreg", "mlp", "cnn_3_layers", "lenet", "alexnet", "vgg16",
-           "vgg19", "resnet18", "resnet34", "rnn", "lstm"]
+__all__ = ["logreg", "mlp", "cnn_3_layers", "digits_cnn", "lenet",
+           "alexnet", "vgg16", "vgg19", "resnet18", "resnet34", "rnn",
+           "lstm"]
 
 
 def fc(x, shape, name, with_relu=True):

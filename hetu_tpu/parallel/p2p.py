@@ -53,6 +53,7 @@ class PipeChannel:
         self._cv = threading.Condition(self._mu)
         self._buffered = 0        # inbox bytes (flow-control accounting)
         self._wanted = set()      # tags an active recv() is blocked on
+        self._sending = 0         # sends in flight (see backpressure)
         self.max_buffered = int(os.environ.get(
             "HETU_PIPE_MAX_BUF_MB", "256")) << 20
         self._out = {}            # dst rank -> socket
@@ -115,16 +116,20 @@ class PipeChannel:
                 with self._cv:
                     # backpressure: hold THIS reader (and via unread TCP
                     # bytes, its sender) while the consumer lags — i.e.
-                    # while NO recv() is blocked. While one is, always
-                    # admit: the message it needs may be behind any
-                    # other message on any connection, so holding the
-                    # cap against an active consumer can deadlock the
-                    # schedule. The cap thus bounds RSS exactly in the
-                    # runaway case (producer far ahead, consumer busy
-                    # elsewhere), which is the case that grows RSS.
+                    # while it is neither in recv() nor in send(). While
+                    # it is, always admit: a blocked recv's message may
+                    # be behind any other message on any connection, and
+                    # a consumer blocked in send() (peer's inbox full,
+                    # TCP window closed) with its own inbox also at cap
+                    # would otherwise deadlock both ranks of a
+                    # bidirectional pipeline. The cap thus bounds RSS
+                    # exactly in the runaway case (producer far ahead,
+                    # consumer busy computing), which is the case that
+                    # grows RSS.
                     self._cv.wait_for(
                         lambda: self._buffered < self.max_buffered
-                        or self._wanted or self._closing)
+                        or self._wanted or self._sending
+                        or self._closing)
                     if self._closing:
                         return
                     self._inbox.setdefault(tag, deque()).append(arr)
@@ -188,13 +193,21 @@ class PipeChannel:
                + struct.pack(f"<{arr.ndim}q", *arr.shape))
         view = memoryview(arr).cast("B")
         s = self._conn_to(dst)
-        with self._out_mu:
-            s.sendall(hdr)
-            # stream the payload from the array's own buffer in chunks:
-            # no whole-message copy, and large boundary tensors
-            # interleave with TCP flow control instead of one giant blob
-            for off in range(0, arr.nbytes, _CHUNK):
-                s.sendall(view[off:off + _CHUNK])
+        with self._cv:
+            self._sending += 1
+            self._cv.notify_all()   # readers may admit while we send
+        try:
+            with self._out_mu:
+                s.sendall(hdr)
+                # stream the payload from the array's own buffer in
+                # chunks: no whole-message copy, and large boundary
+                # tensors interleave with TCP flow control instead of
+                # one giant blob
+                for off in range(0, arr.nbytes, _CHUNK):
+                    s.sendall(view[off:off + _CHUNK])
+        finally:
+            with self._cv:
+                self._sending -= 1
 
     def close(self):
         self._closing = True
