@@ -10,6 +10,7 @@ reference's ``model(x, y_)`` convention.
 """
 from .cnn import (logreg, mlp, cnn_3_layers, digits_cnn, lenet, alexnet,
                   vgg16, vgg19, resnet18, resnet34, rnn, lstm)
+from .gpt import GPTConfig, GPTModel, GPTLMHeadModel
 from .bert import (BertConfig, BertModel, BertForPreTraining,
                    BertForSequenceClassification, BertForMaskedLM)
 from .ctr import (wdl_criteo, wdl_adult, deepfm_criteo, dcn_criteo,

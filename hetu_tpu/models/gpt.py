@@ -1,0 +1,193 @@
+"""GPT family — decoder-only causal language models.
+
+No direct reference equivalent (the reference's NLP zoo stops at BERT,
+examples/nlp/bert/hetu_bert.py); this family exists to make the causal
+attention stack a first-class, user-reachable model path: the Pallas
+flash kernel's ``causal=True`` mode on one chip, and the zigzag causal
+ring / blockwise-causal Ulysses sequence parallelism
+(parallel/ring.py, parallel/ulysses.py) for long-context training —
+``GPTConfig(sequence_parallel="ring"|"ulysses")`` is all a user writes.
+
+Architecture: GPT-2-shaped pre-LN transformer decoder (learned position
+embeddings, gelu MLP, LayerNorm before each sublayer and at the output),
+built from the same layer utilities as models/bert.py. Next-token loss:
+the caller feeds ``labels`` already shifted by one (``ids[:, 1:]`` plus
+a pad), matching the examples' host-side shift.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import initializers as init
+from ..ops import (array_reshape_op, broadcastto_op,
+                   softmaxcrossentropy_sparse_op, split_op, squeeze_op,
+                   transpose_op)
+from ..ops.variable import Variable
+from .bert import (BertLayerNorm as LayerNorm, Dropout, Embedding,
+                   Linear, _act)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 hidden_act="gelu", hidden_dropout_prob=0.1,
+                 max_position_embeddings=1024, initializer_range=0.02,
+                 use_flash_attention=False, sequence_parallel=None):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.use_flash_attention = use_flash_attention
+        # None/False: single-device attention. "ring": zigzag causal
+        # ring over the mesh's "sp" axis. "ulysses": causal all-to-all.
+        # Both fall back to the fused path off-mesh, so a model declares
+        # its parallelism once and runs anywhere.
+        if sequence_parallel is True:
+            sequence_parallel = "ring"
+        self.sequence_parallel = sequence_parallel or None
+
+
+class CausalSelfAttention:
+    """Multi-head causal attention; the mask is a kernel/schedule flag,
+    never a materialized [S, S] tensor."""
+
+    def __init__(self, config, name="attn"):
+        if config.hidden_size % config.num_attention_heads:
+            raise ValueError(
+                f"hidden size {config.hidden_size} not a multiple of "
+                f"num heads {config.num_attention_heads}")
+        self.num_heads = config.num_attention_heads
+        self.head_size = config.hidden_size // config.num_attention_heads
+        self.hidden_size = config.hidden_size
+        self.seq_len = config.max_position_embeddings
+        self.config = config
+        self.name = name
+        self.qkv = Linear(config.hidden_size, 3 * config.hidden_size,
+                          name=name + "_qkv")
+        self.proj = Linear(config.hidden_size, config.hidden_size,
+                           name=name + "_proj")
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def _split_heads(self, x, seq_len, which):
+        # [B*S, 3H] -> [B, S, 3, nh, hs] -> take q/k/v -> [B, nh, S, hs]
+        x = array_reshape_op(
+            x, [-1, seq_len, 3, self.num_heads, self.head_size])
+        x = transpose_op(x, [2, 0, 3, 1, 4])
+        piece = split_op(x, [0], [which], [3])
+        return squeeze_op(piece, axes=[0])
+
+    def __call__(self, hidden_states, seq_len=None):
+        from ..ops.attention import (flash_attention_op,
+                                     ring_attention_op,
+                                     ulysses_attention_op)
+        seq_len = seq_len or self.seq_len
+        qkv = self.qkv(hidden_states, [-1, 3 * self.hidden_size])
+        q = self._split_heads(qkv, seq_len, 0)
+        k = self._split_heads(qkv, seq_len, 1)
+        v = self._split_heads(qkv, seq_len, 2)
+        scale = 1.0 / float(np.sqrt(self.head_size))
+        sp = self.config.sequence_parallel
+        if sp == "ring":
+            ctx = ring_attention_op(q, k, v, sm_scale=scale, causal=True)
+        elif sp == "ulysses":
+            ctx = ulysses_attention_op(q, k, v, sm_scale=scale,
+                                       causal=True)
+        elif self.config.use_flash_attention:
+            ctx = flash_attention_op(q, k, v, sm_scale=scale, causal=True)
+        else:
+            # composed path (XLA-fused batch_matmul + softmax with a
+            # broadcast causal-mask constant) — the graph BertConfig's
+            # same-named flag selects on the encoder side
+            from ..ops import batch_matmul_op, softmax_op
+            cmask = Variable(
+                self.name + "_causal_mask",
+                value=np.where(np.tril(np.ones((seq_len, seq_len), bool)),
+                               0.0, -1e9)[None, None].astype(np.float32),
+                trainable=False)
+            k = k * scale
+            scores = batch_matmul_op(q, k, trans_B=True)
+            scores = scores + broadcastto_op(cmask, scores)
+            ctx = batch_matmul_op(softmax_op(scores), v)
+        ctx = transpose_op(ctx, [0, 2, 1, 3])
+        ctx = array_reshape_op(ctx, [-1, seq_len, self.hidden_size])
+        out = self.proj(ctx, [-1, seq_len, self.hidden_size])
+        return self.dropout(out)
+
+
+class GPTBlock:
+    """Pre-LN decoder block: x += attn(ln1 x); x += mlp(ln2 x)."""
+
+    def __init__(self, config, name="block"):
+        self.ln1 = LayerNorm(config.hidden_size, name=name + "_ln1")
+        self.attn = CausalSelfAttention(config, name=name + "_attn")
+        self.ln2 = LayerNorm(config.hidden_size, name=name + "_ln2")
+        self.fc = Linear(config.hidden_size, config.intermediate_size,
+                         activation=_act(config.hidden_act),
+                         name=name + "_mlp_fc")
+        self.proj = Linear(config.intermediate_size, config.hidden_size,
+                           name=name + "_mlp_proj")
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.hidden_size = config.hidden_size
+
+    def __call__(self, x, seq_len):
+        shape3 = [-1, seq_len, self.hidden_size]
+        x = x + self.attn(self.ln1(x), seq_len)
+        h = self.fc(self.ln2(x), shape3)
+        h = self.proj(h, shape3)
+        return x + self.dropout(h)
+
+
+class GPTModel:
+    """Token + position embeddings, N causal blocks, final LayerNorm."""
+
+    def __init__(self, config):
+        self.config = config
+        self.seq_len = config.max_position_embeddings
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             "gpt_wte")
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size, "gpt_wpe")
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.blocks = [GPTBlock(config, name=f"gpt_h{i}")
+                       for i in range(config.num_hidden_layers)]
+        self.ln_f = LayerNorm(config.hidden_size, name="gpt_ln_f")
+
+    def __call__(self, input_ids, seq_len=None):
+        seq_len = seq_len or self.seq_len
+        position_ids = Variable(
+            "gpt_position_ids",
+            value=np.arange(seq_len).reshape(1, -1), trainable=False)
+        x = self.wte(input_ids)
+        x = x + broadcastto_op(self.wpe(position_ids), x)
+        x = self.dropout(x)
+        for block in self.blocks:
+            x = block(x, seq_len)
+        return self.ln_f(x)
+
+
+class GPTLMHeadModel:
+    """GPTModel + untied LM head; returns (logits, per-position loss)
+    when labels are given (labels pre-shifted by the caller)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.transformer = GPTModel(config)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias=False, name="gpt_lm_head")
+
+    def __call__(self, input_ids, labels=None, seq_len=None):
+        seq_len = seq_len or self.config.max_position_embeddings
+        hidden = self.transformer(input_ids, seq_len)
+        logits = self.lm_head(
+            hidden, [-1, seq_len, self.config.vocab_size])
+        if labels is None:
+            return logits
+        loss = softmaxcrossentropy_sparse_op(logits, labels)
+        return logits, loss
