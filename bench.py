@@ -514,6 +514,60 @@ def bench_gcn():
          best=best / steps * 1000)
 
 
+def gpt_train_flops(batch, seq, hidden, layers, intermediate, vocab):
+    """Analytic FLOPs of one causal-LM training step (fwd*3). Like
+    bert_train_flops but the attention term is halved: the causal flash
+    kernel skips future blocks, so only ~S/2 keys per query are real
+    work — counting full S would inflate the reported MFU."""
+    per_token = layers * (8 * hidden * hidden + 2 * seq * hidden
+                          + 4 * hidden * intermediate) + 2 * hidden * vocab
+    return 3.0 * per_token * batch * seq
+
+
+def bench_gpt():
+    """GPT-2-small causal LM pretraining (S=1024, bf16, Pallas causal
+    flash attention) — the decoder/long-context counterpart of the BERT
+    headline; no reference equivalent (its NLP zoo stops at encoders),
+    so vs_baseline anchors on the same V100-class tokens/s bar."""
+    import jax
+    import jax.numpy as jnp
+
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    import hetu_tpu.models as M
+
+    vocab, seq_len, batch = 50257, 1024, 8
+    cfg = M.GPTConfig(
+        vocab_size=vocab, hidden_size=768, num_hidden_layers=12,
+        num_attention_heads=12, max_position_embeddings=seq_len,
+        hidden_dropout_prob=0.0, use_flash_attention=True)
+    model = M.GPTLMHeadModel(cfg)
+    ids = ht.Variable("input_ids", trainable=False)
+    labels = ht.Variable("labels", trainable=False)
+    _, loss = model(ids, labels)
+    lm = ht.reduce_mean_op(loss, [0, 1])
+    train_op = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(lm)
+    exe = Executor([lm, train_op], dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, vocab, (batch, seq_len))
+    y = np.concatenate([x[:, 1:], np.full((batch, 1), -1)], axis=1)
+    feeds = {ids: jax.device_put(x), labels: jax.device_put(y)}
+    for _ in range(3):
+        out = exe.run(feed_dict=feeds)
+    out[0].asnumpy()
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(feed_dict=feeds)
+    out[0].asnumpy()
+    dt = time.perf_counter() - t0
+    tps = steps * batch * seq_len / dt
+    flops = gpt_train_flops(batch, seq_len, 768, 12, 3072, vocab)
+    emit("gpt2_small_causal_tokens_per_sec_per_chip", tps,
+         "tokens/sec/chip", tps / BERT_BASELINE_TPS,
+         **mfu_fields(flops, dt / steps))
+
+
 def bench_bert():
     """Headline: BERT-base MLM+NSP, bf16 mixed precision, Pallas flash
     attention, batch 64 — printed LAST so the driver's parsed line is the
@@ -790,7 +844,8 @@ def main():
 
     for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
                bench_wdl_hybrid, bench_ncf, bench_gcn, bench_pp,
-               bench_pp_modes, bench_bert_long_seq, bench_bert):
+               bench_pp_modes, bench_bert_long_seq, bench_gpt,
+               bench_bert):
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -30,6 +30,10 @@ def load_corpus(args):
                         "lm", "corpus.npy")
     if os.path.exists(path):
         flat = np.load(path).astype(np.int64)
+        assert flat.max() < args.vocab_size and flat.min() >= 0, (
+            f"corpus ids span [{flat.min()}, {flat.max()}] but "
+            f"--vocab-size is {args.vocab_size}; the embedding gather "
+            "would silently clamp out-of-range ids")
     else:
         rng = np.random.RandomState(0)
         n = args.nsamples * args.seq_len

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import initializers as init
 from ..ops import (array_reshape_op, broadcastto_op,
                    softmaxcrossentropy_sparse_op, split_op, squeeze_op,
                    transpose_op)
@@ -55,8 +54,11 @@ class GPTConfig:
 
 
 class CausalSelfAttention:
-    """Multi-head causal attention; the mask is a kernel/schedule flag,
-    never a materialized [S, S] tensor."""
+    """Multi-head causal attention. On the flash and sequence-parallel
+    paths the mask is a kernel/schedule flag — no [S, S] tensor exists;
+    the composed fallback (use_flash_attention=False, off-mesh)
+    broadcasts an additive [1, 1, S, S] causal-mask constant like the
+    encoder's composed path does."""
 
     def __init__(self, config, name="attn"):
         if config.hidden_size % config.num_attention_heads:

@@ -14,11 +14,12 @@ import hetu_tpu.models as M
 VOCAB, SEQ, BATCH = 64, 32, 4
 
 
-def _build(sp=None, seed_suffix=""):
+def _build(sp=None, flash=False):
     cfg = M.GPTConfig(
         vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
         num_attention_heads=8, max_position_embeddings=SEQ,
-        hidden_dropout_prob=0.0, sequence_parallel=sp)
+        hidden_dropout_prob=0.0, sequence_parallel=sp,
+        use_flash_attention=flash)
     model = M.GPTLMHeadModel(cfg)
     ids = ht.Variable("input_ids", trainable=False)
     labels = ht.Variable("labels", trainable=False)
@@ -51,11 +52,13 @@ def test_gpt_learns_periodic_sequence():
     assert losses[-1] < 1.0, losses[-5:]
 
 
-def test_gpt_logits_are_causal():
+@pytest.mark.parametrize("flash", [False, True])
+def test_gpt_logits_are_causal(flash):
     """Changing ONLY the last input token must not change any earlier
-    position's logits — direct probe that the flash kernel's causal
-    flag masks the future."""
-    ids, labels, logits, lm, train = _build()
+    position's logits — direct probe of the causal masking, on BOTH
+    the composed-mask path and the flash-op path (the one bench_gpt
+    and every use_flash_attention=True user runs)."""
+    ids, labels, logits, lm, train = _build(flash=flash)
     exe = Executor([logits])
     rng = np.random.RandomState(0)
     x1 = rng.randint(0, VOCAB, (1, SEQ))
@@ -68,6 +71,25 @@ def test_gpt_logits_are_causal():
                             convert_to_numpy_ret_vals=True)[0])
     np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], atol=1e-5)
     assert np.abs(l1[:, -1] - l2[:, -1]).max() > 1e-3
+
+
+def test_gpt_flash_matches_composed():
+    """use_flash_attention=True and False build different graphs but
+    the same math: identical losses over a few training steps."""
+    rng = np.random.RandomState(2)
+    x = rng.randint(0, VOCAB, (BATCH, SEQ))
+    y = _shifted(x)
+    ids, labels, _, lm, train = _build(flash=False)
+    ref = Executor([lm, train])
+    want = [float(ref.run(feed_dict={ids: x, labels: y},
+                          convert_to_numpy_ret_vals=True)[0])
+            for _ in range(3)]
+    ids2, labels2, _, lm2, train2 = _build(flash=True)
+    exe = Executor([lm2, train2])
+    got = [float(exe.run(feed_dict={ids2: x, labels2: y},
+                         convert_to_numpy_ret_vals=True)[0])
+           for _ in range(3)]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
 @pytest.mark.parametrize("sp", ["ring", "ulysses"])
