@@ -95,11 +95,15 @@ class CollectiveGPipe:
             m = t - r
             mc = jnp.clip(m, 0, M - 1)
             rng = jax.random.fold_in(base_rng, step * 131 + mc)
+            # fill/drain ticks compute on zero lanes rather than
+            # branching them out: an A/B with a lax.cond skip measured
+            # ~1.5x SLOWER end-to-end (the per-tick branch blocks
+            # fusion and costs more than the saved compute); the
+            # garbage lanes' outputs receive zero cotangents, so they
+            # contribute nothing to gradients. The inherent overhead is
+            # (M+S-1)/M — amortize with M >> S.
             y, loss = lax.switch(r, self.branches, plist, x_cur,
                                  feeds_all, mc, rng)
-            # only the last stage's in-range ticks carry real losses;
-            # out-of-range ticks compute on zeros (their outputs receive
-            # zero cotangents, so they contribute nothing to gradients)
             valid = (m >= 0) & (m < M) & (r == S - 1)
             loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
             if shift:
