@@ -217,3 +217,43 @@ def test_ps_stream_matches_run_batches(ps_env):
     np.testing.assert_allclose(got_cache, want_cache, rtol=1e-5)
     assert exe2.ps_runtime.times["feed_ingest"] >= 0.0
     exe2.close()
+
+
+def _softmax_model(prefix):
+    """Same 1-layer softmax model under a name prefix (two fresh graphs
+    with identical init values, the file's _embed_model convention)."""
+    rng = np.random.RandomState(5)
+    x = ht.Variable(prefix + "_x", trainable=False)
+    y_ = ht.Variable(prefix + "_y", trainable=False)
+    w = ht.Variable(prefix + "_w", value=rng.randn(8, 4).astype("f") * 0.3)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y_), [0])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return x, y_, w, loss, train
+
+
+def test_stream_non_ps_matches_run_batches():
+    """run_batches_stream on a plain (non-PS) executor falls back to the
+    scan-block path with identical results."""
+    rng = np.random.RandomState(6)
+    raw = [(rng.randn(16, 8).astype("f"),
+            np.eye(4, dtype="f")[rng.randint(0, 4, 16)])
+           for _ in range(6)]
+
+    x, y_, w, loss, train = _softmax_model("s")
+    data = [{x: d, y_: y} for d, y in raw]
+    exe = Executor([loss, train])
+    for chunk in (data[:3], data[3:]):
+        out = exe.run_batches(chunk, convert_to_numpy_ret_vals=True)
+    want = float(out[-1][0])
+    want_w = np.asarray(exe.params[str(w.id)])
+
+    x2, y2, w2, loss2, train2 = _softmax_model("s2")
+    data2 = [{x2: d, y2: y} for d, y in raw]
+    exe2 = Executor([loss2, train2])
+    out2 = exe2.run_batches_stream(
+        (c for c in (data2[:3], data2[3:])), convert_to_numpy_ret_vals=True)
+    got = float(out2[-1][0])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(exe2.params[str(w2.id)]),
+                               want_w, rtol=1e-5)
