@@ -260,6 +260,32 @@ def _gelu(ex, node):
                    ex.add("Add", [e, one])], [ex.name(node)])
 
 
+@handles("LayerNormalizationOp")
+def _layer_norm(ex, node):
+    # decomposed into opset-11 primitives (the fused ONNX
+    # LayerNormalization op needs opset 17): mean/variance over the last
+    # axis, normalize, scale + shift — numerically the same computation
+    # ops/norm.py runs. Broadcasts are EXPLICIT Expands so the graph
+    # also round-trips through this package's importer, whose binary
+    # ops (like the framework's) take equal shapes.
+    x, scale, bias = (ex.name(i) for i in node.inputs)
+    full = ex.const(np.asarray(node.inputs[0].inferred_shape, np.int64),
+                    "shape")
+
+    def expand(name):
+        return ex.add("Expand", [name, full])
+
+    mean = ex.add("ReduceMean", [x], axes=[-1], keepdims=1)
+    d = ex.add("Sub", [x, expand(mean)])
+    var = ex.add("ReduceMean", [ex.add("Mul", [d, d])],
+                 axes=[-1], keepdims=1)
+    eps = ex.const(np.float32(node.eps))
+    denom = ex.add("Sqrt", [ex.add("Add", [expand(var), expand(eps)])])
+    xhat = ex.add("Div", [d, denom])
+    ex.add("Add", [ex.add("Mul", [xhat, expand(scale)]),
+                   expand(bias)], [ex.name(node)])
+
+
 @handles("DropoutOp")
 def _dropout(ex, node):
     ex.add("Dropout", [_in(ex, node)], [ex.name(node)],
@@ -294,6 +320,24 @@ def _slice(ex, node):
     ends = [int(b + (in_shape[i] - b if s == -1 else s))
             for i, (b, s) in enumerate(zip(node.begin_pos,
                                            node.output_shape))]
+    ex.add("Slice", [_in(ex, node),
+                     ex.const(np.asarray(starts, np.int64), "starts"),
+                     ex.const(np.asarray(ends, np.int64), "ends")],
+           [ex.name(node)])
+
+
+@handles("SplitOp")
+def _split(ex, node):
+    # one piece of an even split == a Slice over the split axes (the
+    # importer's Slice handler reconstructs the same slice_op)
+    in_shape = node.inputs[0].inferred_shape
+    nd = max(node.axes) + 1
+    starts = [0] * nd
+    ends = [int(in_shape[i]) for i in range(nd)]
+    for ax, ind, spl in zip(node.axes, node.indices, node.splits):
+        size = int(in_shape[ax]) // spl
+        starts[ax] = ind * size
+        ends[ax] = (ind + 1) * size
     ex.add("Slice", [_in(ex, node),
                      ex.const(np.asarray(starts, np.int64), "starts"),
                      ex.const(np.asarray(ends, np.int64), "ends")],

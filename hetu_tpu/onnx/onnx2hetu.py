@@ -102,7 +102,12 @@ def _unop(build):
 _IMPORTERS["Add"] = _binop(ops.add_op)
 _IMPORTERS["Mul"] = _binop(ops.mul_op)
 _IMPORTERS["Div"] = _binop(ops.div_op)
-_IMPORTERS["MatMul"] = _binop(ops.matmul_op)
+# ONNX MatMul is N-D batched; batch_matmul_op handles 2D and
+# equal-batch-dim N-D (this package's own exports). ONNX's broadcast
+# MatMul (e.g. [B,T,H] x [H,H]) and 1D operands are NOT covered —
+# BatchMatMulOp asserts identical batch dims; extend with an explicit
+# Expand on import if a foreign model needs them.
+_IMPORTERS["MatMul"] = _binop(ops.batch_matmul_op)
 _IMPORTERS["Neg"] = _unop(ops.opposite_op)
 _IMPORTERS["Sqrt"] = _unop(ops.sqrt_op)
 _IMPORTERS["Relu"] = _unop(ops.relu_op)

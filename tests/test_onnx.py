@@ -130,3 +130,48 @@ def test_gelu_embedding_roundtrip(tmp_path):
     # exported gelu is the exact erf form; the in-graph op uses the tanh
     # approximation — matches to the approximation's accuracy
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_layer_norm_split_roundtrip(tmp_path):
+    """LayerNormalization decomposes into opset-11 primitives at export
+    and SplitOp lowers to Slice — both round-trip exactly (the handlers
+    GPT export needs)."""
+    rng = np.random.RandomState(7)
+    x = ht.Variable("ln_x", trainable=False)
+    scale = ht.init.ones(name="ln_scale", shape=(12,))
+    bias = ht.init.zeros(name="ln_bias", shape=(12,))
+    normed = ht.layer_normalization_op(x, scale, bias, eps=1e-5)
+    piece = ht.split_op(normed, [1], [1], [3])    # middle third
+    exe = Executor([piece])
+    xv = rng.randn(4, 12).astype(np.float32) * 2.0
+    want = exe.run(feed_dict={x: xv}, convert_to_numpy_ret_vals=True)[0]
+
+    path = str(tmp_path / "ln.onnx")
+    export(exe, [x], [piece], path)
+    outputs, feeds = load_onnx(path)
+    got = _run(outputs, {feeds[0]: xv})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_roundtrip(tmp_path):
+    """The full GPT decoder (composed attention path) exports and
+    re-imports; outputs match within the documented erf-vs-tanh gelu
+    divergence (see test_gelu_embedding_roundtrip)."""
+    import hetu_tpu.models as M
+
+    cfg = M.GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=16,
+                      hidden_dropout_prob=0.0)
+    model = M.GPTLMHeadModel(cfg)
+    ids = ht.Variable("onnx_gpt_ids", trainable=False)
+    logits = model(ids)
+    exe = Executor([logits])
+    rng = np.random.RandomState(0)
+    xv = rng.randint(0, 64, (2, 16))
+    want = exe.run(feed_dict={ids: xv}, convert_to_numpy_ret_vals=True)[0]
+
+    path = str(tmp_path / "gpt.onnx")
+    export(exe, [ids], [logits], path)
+    outputs, feeds = load_onnx(path)
+    got = _run(outputs, {feeds[0]: xv})[0]
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-3)
