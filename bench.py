@@ -288,11 +288,15 @@ def bench_wdl_ps():
         # cache_bound 100 = the reference CTR default (--bound 100);
         # bf16 drains halve the accumulator D2H, the dominant link cost
         # fresh batches per step, Criteo-like skew: ids drawn zipf-ish so
-        # the hot set dominates (real Criteo slots are heavily skewed)
+        # the hot set dominates (real Criteo slots are heavily skewed).
+        # ids as int32, not numpy's int64 default: the id stream is the
+        # dominant per-step feed and this halves its bytes on the link
         ncycle = 100
-        zipf = (rng.zipf(1.3, size=(ncycle, batch, 26)) - 1) % 1_000_000
+        zipf = ((rng.zipf(1.3, size=(ncycle, batch, 26)) - 1)
+                % 1_000_000).astype(np.int32)
         dense_in = rng.randn(batch, 13).astype("f")
         y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+        bytes_per_step = zipf[0].nbytes + dense_in.nbytes + y_in.nbytes
         kblock = 100    # lax.scan block: 100 steps per dispatch
         # (measured: 2x throughput over kblock=20 on the tunnel)
 
@@ -334,7 +338,7 @@ def bench_wdl_ps():
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
-             h2d_MBps=h2d_probe_mbps(),
+             h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()     # drain before the finally block kills the server
     finally:
@@ -371,9 +375,12 @@ def bench_wdl_hybrid():
                        cstable_policy="Device", cache_bound=100,
                        drain_compress=True)
         ncycle = 100
-        zipf = (rng.zipf(1.3, size=(ncycle, batch, 26)) - 1) % 1_000_000
+        # int32 ids: half the id-stream bytes of numpy's int64 default
+        zipf = ((rng.zipf(1.3, size=(ncycle, batch, 26)) - 1)
+                % 1_000_000).astype(np.int32)
         dense_in = rng.randn(batch, 13).astype("f")
         y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+        bytes_per_step = zipf[0].nbytes + dense_in.nbytes + y_in.nbytes
         kblock = 100
 
         def block(i0):
@@ -395,7 +402,7 @@ def bench_wdl_hybrid():
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
-             h2d_MBps=h2d_probe_mbps(),
+             h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:
@@ -434,10 +441,15 @@ def bench_ncf():
                        cstable_policy="Device", cache_bound=100,
                        drain_compress=True)
         ncycle = 100
-        users_in = rng.randint(0, ML25M_USERS, (ncycle, batch))
+        # int32 ids (not numpy's int64 default): halves the id bytes
+        users_in = rng.randint(0, ML25M_USERS, (ncycle, batch)
+                               ).astype(np.int32)
         # items zipf-skewed like real MovieLens popularity
-        items_in = (rng.zipf(1.3, size=(ncycle, batch)) - 1) % ML25M_ITEMS
+        items_in = ((rng.zipf(1.3, size=(ncycle, batch)) - 1)
+                    % ML25M_ITEMS).astype(np.int32)
         y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+        bytes_per_step = (users_in[0].nbytes + items_in[0].nbytes
+                          + y_in.nbytes)
         kblock = 100
 
         def block(i0):
@@ -459,7 +471,9 @@ def bench_ncf():
         emit("ncf_ml25m_hybrid_samples_per_sec_per_chip",
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / NCF_BASELINE_SPS,
-             best=float(max(sps_all)))
+             best=float(max(sps_all)),
+             h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
+             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:
         client.shutdown_servers()
@@ -689,12 +703,15 @@ sys.path.insert(0, os.environ["HETU_REPO"])
 import hetu_tpu as ht
 from hetu_tpu.executor import Executor
 
-H, B, NST, M, STEPS = 512, 64, 4, 4, 30
+H, B, NST, STEPS = 512, 64, 4, 30
+MS = (4, 8, 16, 32)      # microbatch sweep: (M+S-1)/M amortization
+M_HEAD = 4               # headline M, fixed since round 4 (continuity)
+M_AB = 16                # the issue-1 target operating point
 rng = np.random.RandomState(0)
 xv = rng.randn(B, H).astype("f")
 yv = np.eye(H, dtype="f")[rng.randint(0, H, B)]
 
-def build(nst, collective=False, single=False):
+def build(nst, single=False):
     r = np.random.RandomState(1)
     act = x = None
     for s in range(nst):
@@ -713,19 +730,33 @@ def build(nst, collective=False, single=False):
                 train = ht.optim.SGDOptimizer(0.05).minimize(loss)
     return x, y_, loss, train
 
-def time_exe(exe, x, y_):
+def time_exe(exe, x, y_, windows=3):
     fd = {x: xv, y_: yv}
     for _ in range(3):
         out = exe.run(feed_dict=fd)
     np.asarray(out[0].asnumpy())
     times = []
-    for _ in range(3):
+    for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(STEPS):
             out = exe.run(feed_dict=fd)
         np.asarray(out[0].asnumpy())
         times.append((time.perf_counter() - t0) / STEPS * 1000)
     return min(times), float(np.median(times))
+
+def time_staged(M):
+    x, y_, loss, train = build(NST)
+    exe = Executor([loss, train], gpipe=True, num_microbatches=M)
+    sub = exe.subexecutors["default"]
+    best, med = time_exe(exe, x, y_)
+    assert sub._fused_step is None, "expected the staged (2S-1) path"
+    return best, med
+
+def time_coll(M, opts=None, windows=3):
+    x, y_, loss, train = build(NST)
+    exe = Executor([loss, train], pipeline_mode="collective",
+                   num_microbatches=M, pp_options=opts)
+    return time_exe(exe, x, y_, windows=windows)
 
 x, y_, loss, train = build(NST, single=True)
 exe = Executor([loss, train])
@@ -739,28 +770,66 @@ for _ in range(STEPS):
 np.asarray(out[0].asnumpy())
 single_ms = (time.perf_counter() - t0) / STEPS * 1000
 
-x, y_, loss, train = build(NST)
-exe = Executor([loss, train], gpipe=True, num_microbatches=M)
-sub = exe.subexecutors["default"]
-staged_best, staged_med = time_exe(exe, x, y_)
-assert sub._fused_step is None, "expected the staged (2S-1) path"
+sweep = {}
+for M in MS:
+    sb, sm = time_staged(M)
+    cb, cm = time_coll(M)
+    sweep[M] = {"staged": round(sb, 2), "collective": round(cb, 2),
+                "staged_median": round(sm, 2),
+                "collective_median": round(cm, 2),
+                "coll_vs_staged": round(sb / cb, 3)}
 
-x, y_, loss, train = build(NST)
-exe = Executor([loss, train], pipeline_mode="collective",
-               num_microbatches=M)
-coll_best, coll_med = time_exe(exe, x, y_)
+# per-variant A/B at the target operating point (each variant is
+# loss-equivalent, asserted by tests/test_collective_pp.py)
+ab = {}
+for name, opts in (
+        ("repl_scan", {"feed_mode": "replicated", "fuse_ticks": 1,
+                       "unroll_fill_drain": False}),
+        ("shard_scan", {"feed_mode": "sharded", "fuse_ticks": 1,
+                        "unroll_fill_drain": False}),
+        ("shard_fuse2", {"feed_mode": "sharded", "fuse_ticks": 2,
+                         "unroll_fill_drain": False}),
+        ("shard_unroll", {"feed_mode": "sharded", "fuse_ticks": 1,
+                          "unroll_fill_drain": True}),
+        ("shard_unroll_fuse2", {"feed_mode": "sharded", "fuse_ticks": 2,
+                                "unroll_fill_drain": True}),
+        ("default_bf16", {"feed_mode": "sharded", "fuse_ticks": 2,
+                          "unroll_fill_drain": True,
+                          "boundary_dtype": "bf16"})):
+    ab[name] = round(time_coll(M_AB, opts, windows=2)[0], 2)
 
+staged_best = sweep[M_HEAD]["staged"]
+coll_best = sweep[M_HEAD]["collective"]
+bubble = (M_HEAD + NST - 1) / M_HEAD
 print(json.dumps({"metric": "pp_gpipe_4stage_staged_step_time",
-                  "value": round(staged_best, 2), "unit": "ms/step",
+                  "value": staged_best, "unit": "ms/step",
                   "vs_baseline": round(single_ms / staged_best, 3),
-                  "median": round(staged_med, 2),
+                  "median": sweep[M_HEAD]["staged_median"],
                   "single_device_anchor_ms": round(single_ms, 2),
+                  # analytic GPipe bubble at the headline M: the
+                  # inherent (M+S-1)/M cost; pipeline_efficiency
+                  # divides it out so what remains is implementation
+                  # overhead (VERDICT r5 weak #3)
+                  "bubble_factor": round(bubble, 3),
+                  "pipeline_efficiency": round(
+                      single_ms / (staged_best * bubble), 3),
+                  "m_sweep": {str(m): sweep[m]["staged"] for m in MS},
                   "platform": "cpu-8dev"}), flush=True)
 print(json.dumps({"metric": "pp_collective_4stage_step_time",
-                  "value": round(coll_best, 2), "unit": "ms/step",
+                  "value": coll_best, "unit": "ms/step",
                   "vs_baseline": round(staged_best / coll_best, 3),
-                  "median": round(coll_med, 2),
-                  "staged_anchor_ms": round(staged_best, 2),
+                  "median": sweep[M_HEAD]["collective_median"],
+                  "staged_anchor_ms": staged_best,
+                  "m_sweep": {str(m): sweep[m] for m in MS},
+                  "variant_ab_ms_m16": ab,
+                  "platform": "cpu-8dev"}), flush=True)
+print(json.dumps({"metric": "pp_collective_vs_staged_m16",
+                  "value": sweep[M_AB]["coll_vs_staged"],
+                  "unit": "ratio (staged/collective, >1 = "
+                          "collective wins)",
+                  "vs_baseline": sweep[M_AB]["coll_vs_staged"],
+                  "staged_ms": sweep[M_AB]["staged"],
+                  "collective_ms": sweep[M_AB]["collective"],
                   "platform": "cpu-8dev"}), flush=True)
 """
 
@@ -769,7 +838,11 @@ def bench_pp_modes():
     """Staged (2S-1 dispatch) and collective (one shard_map program)
     pipeline step times over four REAL distinct devices — the
     multi-dispatch PP numbers VERDICT r4 asked for (the in-TPU bench_pp
-    above measures the fused co-resident path). The bench host has one
+    above measures the fused co-resident path). Sweeps M in {4,8,16,32}
+    for BOTH runners so the (M+S-1)/M bubble amortization is visible in
+    the artifact, and A/Bs every collective tick-loop variant (feed
+    sharding / fused ticks / unrolled fill-drain / bf16 boundaries) at
+    M=16 — the ISSUE 1 target operating point. The bench host has one
     TPU chip, so this runs on an 8-virtual-device CPU mesh in a
     subprocess; the numbers are honest relative dispatch/transfer
     overheads, anchored to the same model on one device of the same
@@ -780,14 +853,14 @@ def bench_pp_modes():
     env = {**os.environ, "HETU_REPO": repo}
     out = subprocess.run([sys.executable, "-c", _PP_MODES_SCRIPT],
                          env=env, capture_output=True, text=True,
-                         timeout=900)
+                         timeout=1800)
     metrics = [l for l in out.stdout.splitlines() if l.startswith("{")]
     for line in metrics:
         print(line, flush=True)
-    if out.returncode != 0 or len(metrics) < 2:
+    if out.returncode != 0 or len(metrics) < 3:
         raise RuntimeError(
             f"pp-modes subprocess failed (rc={out.returncode}, "
-            f"{len(metrics)}/2 metrics):\n{out.stderr[-2000:]}")
+            f"{len(metrics)}/3 metrics):\n{out.stderr[-2000:]}")
 
 
 def bench_bert_long_seq():

@@ -102,7 +102,7 @@ class HetuConfig:
                  cache_capacity=None, log_path=None, gpipe=False,
                  pipedream=False, dynamic_memory=False, mesh=None,
                  dtype=None, num_microbatches=None, drain_compress=False,
-                 pipeline_mode=None):
+                 pipeline_mode=None, pp_options=None):
         maybe_init_distributed()
         self.eval_node_list = eval_node_list
         self.train_name = train_name
@@ -130,6 +130,10 @@ class HetuConfig:
         # "collective": one shard_map program over a stage mesh axis with
         # ppermute boundary shifts (parallel/collective_pp.py)
         self.pipeline_mode = pipeline_mode
+        # collective-mode tuning knobs (feed_mode / fuse_ticks /
+        # unroll_fill_drain / boundary_dtype), forwarded verbatim to
+        # CollectiveGPipe — see parallel/collective_pp.py
+        self.pp_options = pp_options
         self.num_microbatches = num_microbatches
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype

@@ -32,6 +32,29 @@ forward folds the same RNG (step*131 + m), the loss is the mean over
 microbatches, and one optimizer step applies the summed gradients — so
 losses are bit-comparable with pipeline.py's ``_run_gpipe_compiled``
 (tests/test_collective_pp.py asserts it).
+
+Tick-loop tuning knobs (the round-6 perf rewrite; every combination is
+loss-equivalent to the staged runner, asserted per-variant by
+tests/test_collective_pp.py — bf16 boundaries under a looser, documented
+tolerance):
+
+  * ``feed_mode`` — "sharded" (default) packs each stage's microbatch
+    feeds into one byte row of a ``[S, row_bytes]`` uint8 array sharded
+    over the stage axis, so a device receives ONLY its own stage's feed
+    bytes (branch s decodes its slices at static offsets). "replicated"
+    is the old transport: every feed enters with a replicated ``P()``
+    spec, so all M microbatches of every stage's feeds stream through
+    every device — S x the h2d bytes of the sharded path.
+  * ``fuse_ticks=K`` — the schedule scan advances K ticks per iteration
+    (XLA fuses across the tick boundary); trailing padded ticks compute
+    masked garbage, which is safe at the END of the schedule only (the
+    loss mask drops them and x_last is discarded).
+  * ``unroll_fill_drain`` — the S-1 fill and S-1 drain ticks unroll out
+    of the scan (they can fuse with program entry/exit); only the
+    steady-state ticks loop.
+  * ``boundary_dtype`` — "bf16" casts the ppermute payload at stage
+    boundaries (halving boundary bytes on the wire); compute and the
+    loss/gradient/optimizer math stay fp32.
 """
 from __future__ import annotations
 
@@ -41,30 +64,37 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .mesh import shard_map_unchecked as _shard_map_unchecked
+
 __all__ = ["CollectiveGPipe"]
 
 
-def _shard_map():
-    try:
-        from jax import shard_map
-    except ImportError:                   # older jax
-        from jax.experimental.shard_map import shard_map
-    return shard_map
+def _canon_boundary_dtype(boundary_dtype):
+    if boundary_dtype in (None, "fp32", "f32", "float32"):
+        return None
+    if boundary_dtype in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    return np.dtype(boundary_dtype)
 
 
 class CollectiveGPipe:
     """Compiled SPMD GPipe step over a ``stage`` mesh axis.
 
     branches: list of S callables with the uniform signature
-    ``branch(plist, x, feeds_all, m, rng) -> (boundary_out, loss)`` —
-    plist is the device-local per-position parameter list, x the incoming
-    boundary activation, feeds_all the tuple of every stage's stacked
-    ``[M, mb, ...]`` feeds (branch s reads only feeds_all[s], sliced at
-    microbatch m), and loss a scalar (zero except the last stage).
+    ``branch(plist, x, feeds, rng) -> (boundary_out, loss)`` — plist is
+    the device-local per-position parameter list, x the incoming boundary
+    activation, feeds the per-microbatch feed list for that stage
+    (already sliced at microbatch m by the feed transport), and loss a
+    scalar (zero except the last stage).
     """
 
     def __init__(self, branches, boundary_aval, num_microbatches, mesh,
-                 axis_name, optimizer):
+                 axis_name, optimizer, feed_mode="sharded", fuse_ticks=2,
+                 unroll_fill_drain=True, boundary_dtype=None):
+        if feed_mode not in ("sharded", "replicated"):
+            raise ValueError(
+                f"feed_mode must be 'sharded' or 'replicated', got "
+                f"{feed_mode!r}")
         self.branches = branches
         self.S = len(branches)
         self.M = num_microbatches
@@ -72,48 +102,194 @@ class CollectiveGPipe:
         self.axis_name = axis_name
         self.optimizer = optimizer
         self.boundary_aval = boundary_aval
+        self.feed_mode = feed_mode
+        self.fuse_ticks = max(1, int(fuse_ticks))
+        self.unroll_fill_drain = bool(unroll_fill_drain)
+        self.boundary_dtype = _canon_boundary_dtype(boundary_dtype)
         self._step = None
         self._feed_cache = {}     # (stage, j) -> (src array, replicated)
+        self._packed_cache = None  # (leaf refs, packed [S, row_bytes])
+        self._layout = None       # per stage: [(offset, shape, dtype)]
+        self._row_bytes = 1
+
+    # -- stage-sharded feed transport -----------------------------------
+    def _build_layout(self, feeds_all):
+        """Byte layout of each stage's feed bundle inside its row of the
+        packed ``[S, row_bytes]`` array: per feed, (byte offset, stacked
+        [M, mb, ...] shape, dtype). Offsets are static per stage, so
+        branch s decodes its feeds with static slices + bitcasts."""
+        layout, row_bytes = [], 0
+        for fs in feeds_all:
+            off, stage = 0, []
+            for f in fs:
+                shape = tuple(int(d) for d in f.shape)
+                dt = np.dtype(f.dtype)
+                stage.append((off, shape, dt))
+                off += int(np.prod(shape)) * dt.itemsize
+            layout.append(stage)
+            row_bytes = max(row_bytes, off)
+        self._layout = layout
+        self._row_bytes = max(row_bytes, 1)
+
+    def _pack_feeds(self, feeds_all):
+        """Stage feeds -> one ``[S, row_bytes]`` uint8 array sharded over
+        the stage axis: device s receives only stage s's feed bytes (the
+        replicated transport moved every stage's feeds to every device).
+        Identity-cached so pinned feeds pack + transfer once, not once
+        per step. Packing is a host-side byte copy (jax feed arrays sync
+        d2h once on first pack; steady-state steps hit the cache)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        leaves = tuple(f for fs in feeds_all for f in fs)
+        hit = self._packed_cache
+        if hit is not None and len(hit[0]) == len(leaves) and \
+                all(a is b for a, b in zip(hit[0], leaves)):
+            return hit[1]
+        rows = np.zeros((self.S, self._row_bytes), np.uint8)
+        for s, fs in enumerate(feeds_all):
+            if len(fs) != len(self._layout[s]):
+                raise ValueError(
+                    f"collective pipeline stage {s} got {len(fs)} feeds; "
+                    f"built for {len(self._layout[s])}")
+            for j, ((off, shape, dt), f) in enumerate(
+                    zip(self._layout[s], fs)):
+                if tuple(np.shape(f)) != shape or np.dtype(f.dtype) != dt:
+                    # the byte layout is compiled into the program, so a
+                    # shape change cannot retrace its way to correctness
+                    # (the packed array stays [S, row_bytes]) — fail
+                    # loudly instead of decoding garbage
+                    raise ValueError(
+                        f"collective pipeline feed changed shape/dtype "
+                        f"after build: stage {s} feed {j} is "
+                        f"{tuple(np.shape(f))}/{np.dtype(f.dtype)}, built "
+                        f"for {shape}/{dt} — keep the batch size fixed "
+                        f"or rebuild the executor")
+                b = np.ascontiguousarray(np.asarray(f), dtype=dt)
+                b = b.view(np.uint8).ravel()
+                rows[s, off:off + b.size] = b
+        packed = jax.device_put(
+            rows, NamedSharding(self.mesh, P(self.axis_name)))
+        self._packed_cache = (leaves, packed)
+        return packed
+
+    def _decode_feeds(self, words, s, mc):
+        """Stage s's microbatch-mc feed list out of its local byte row
+        (static offsets/shapes; only the microbatch index is dynamic)."""
+        out = []
+        for off, shape, dt in self._layout[s]:
+            M = shape[0]
+            nb = int(np.prod(shape)) * dt.itemsize
+            blk = words[off:off + nb].reshape((M, nb // M))
+            row = jnp.take(blk, mc, axis=0)
+            if dt.itemsize == 1:
+                row = row.reshape(shape[1:])
+            else:
+                row = row.reshape(tuple(shape[1:]) + (dt.itemsize,))
+            out.append(lax.bitcast_convert_type(row, dt))
+        return out
 
     # -- the per-device schedule body (runs inside shard_map) -----------
-    def _body(self, params_local, feeds_all, base_rng, step):
+    def _body(self, params_local, feed_arg, base_rng, step):
+        """Forward schedule AND backward, differentiated per device: the
+        body returns (partial loss, local param grads). Taking the grad
+        INSIDE the shard_map is what makes the one-program design hold
+        up — the transpose of each tick's ``ppermute`` is the inverse
+        permute, so cotangents flow stage S-1 -> 0 across devices inside
+        the same compiled program, and no jax AD machinery ever crosses
+        the shard_map boundary (jax 0.4.x's partial-eval of shard_map
+        mis-specs scan residuals under check_rep=False)."""
         axis = self.axis_name
-        S, M = self.S, self.M
+        S, M, K = self.S, self.M, self.fuse_ticks
         r = lax.axis_index(axis)
-        plist = [jnp.squeeze(p, 0) for p in params_local]
+        if self.feed_mode == "sharded":
+            feed_local = jnp.squeeze(feed_arg, 0)
+        else:
+            feed_local = feed_arg
         shift = [(i, i + 1) for i in range(S - 1)]
-        x0 = jnp.zeros(self.boundary_aval.shape, self.boundary_aval.dtype)
+        carry_dt = self.boundary_dtype or self.boundary_aval.dtype
+        x0 = jnp.zeros(self.boundary_aval.shape, carry_dt)
         loss0 = jnp.float32(0.0)
         if hasattr(lax, "pvary"):
-            # scan carries change varying-over-mesh type inside the loop;
-            # the initial values must already carry it
+            # loop carries change varying-over-mesh type inside the
+            # tick loop; the initial values must already carry it
             x0 = lax.pvary(x0, (axis,))
             loss0 = lax.pvary(loss0, (axis,))
 
-        def tick(carry, t):
-            x_cur, loss_acc = carry
-            m = t - r
-            mc = jnp.clip(m, 0, M - 1)
-            rng = jax.random.fold_in(base_rng, step * 131 + mc)
-            # fill/drain ticks compute on zero lanes rather than
-            # branching them out: an A/B with a lax.cond skip measured
-            # ~1.5x SLOWER end-to-end (the per-tick branch blocks
-            # fusion and costs more than the saved compute); the
-            # garbage lanes' outputs receive zero cotangents, so they
-            # contribute nothing to gradients. The inherent overhead is
-            # (M+S-1)/M — amortize with M >> S.
-            y, loss = lax.switch(r, self.branches, plist, x_cur,
-                                 feeds_all, mc, rng)
-            valid = (m >= 0) & (m < M) & (r == S - 1)
-            loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
-            if shift:
-                y = lax.ppermute(y, axis, shift)
-            return (y, loss_acc), None
+        if self.feed_mode == "sharded":
+            def stage_call(s):
+                br = self.branches[s]
 
-        (x_last, loss_acc), _ = lax.scan(
-            tick, (x0, loss0), jnp.arange(M + S - 1))
-        del x_last
-        return lax.psum(loss_acc, axis) / M
+                def call(plist, x, words, mc, rng):
+                    return br(plist, x,
+                              self._decode_feeds(words, s, mc), rng)
+                return call
+        else:
+            def stage_call(s):
+                br = self.branches[s]
+
+                def call(plist, x, feeds_all, mc, rng):
+                    feeds = [jnp.take(f, mc, axis=0)
+                             for f in feeds_all[s]]
+                    return br(plist, x, feeds, rng)
+                return call
+        wrapped = [stage_call(s) for s in range(S)]
+
+        def schedule_loss(params_loc):
+            plist = [jnp.squeeze(p, 0) for p in params_loc]
+
+            def tick(carry, t):
+                x_cur, loss_acc = carry
+                m = t - r
+                mc = jnp.clip(m, 0, M - 1)
+                rng = jax.random.fold_in(base_rng, step * 131 + mc)
+                # fill/drain ticks compute on zero lanes rather than
+                # branching them out: an A/B with a lax.cond skip
+                # measured ~1.5x SLOWER end-to-end (the per-tick branch
+                # blocks fusion and costs more than the saved compute);
+                # the garbage lanes' outputs receive zero cotangents, so
+                # they contribute nothing to gradients. The inherent
+                # overhead is (M+S-1)/M — amortize with M >> S.
+                xin = x_cur.astype(self.boundary_aval.dtype)
+                y, loss = lax.switch(r, wrapped, plist, xin, feed_local,
+                                     mc, rng)
+                valid = (m >= 0) & (m < M) & (r == S - 1)
+                loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
+                y = y.astype(carry_dt)
+                if shift:
+                    y = lax.ppermute(y, axis, shift)
+                return (y, loss_acc)
+
+            # schedule driver: optional unrolled fill/drain around a
+            # scan that advances K ticks per iteration. Padded extra
+            # ticks (when K does not divide the looped count) spill
+            # PAST the end of the region the scan covers — in-order, so
+            # the schedule stays exact; ticks beyond M+S-2 only touch
+            # the masked loss and the discarded x_last, never an
+            # in-flight boundary.
+            T = M + S - 1
+            carry = (x0, loss0)
+            n_pre = min(S - 1, T) if self.unroll_fill_drain else 0
+            n_mid = max(M - S + 1, 0) if self.unroll_fill_drain else T
+            niters = -(-n_mid // K) if n_mid else 0
+            for t in range(n_pre):
+                carry = tick(carry, t)
+            if niters:
+                def body(c, t0):
+                    for k in range(K):
+                        c = tick(c, t0 + k)
+                    return c, None
+                carry, _ = lax.scan(
+                    body, carry, n_pre + K * jnp.arange(niters))
+            for t in range(n_pre + K * niters, T):
+                carry = tick(carry, t)
+            # per-device partial of the mean-over-microbatches loss
+            # (only the last stage's lane is nonzero): the cross-stage
+            # reduction happens OUTSIDE the shard_map as a plain sum
+            # over the [S] output — no in-body collective needed
+            return carry[1] / M
+
+        loss_part, grads_local = jax.value_and_grad(
+            schedule_loss)(params_local)
+        return loss_part[None], grads_local
 
     @staticmethod
     def _norm_feeds(feeds_all):
@@ -123,20 +299,23 @@ class CollectiveGPipe:
         """Jit the full training step (forward schedule + backward +
         optimizer) with donated param/slot buffers."""
         from jax.sharding import PartitionSpec as P
-        shard_map = _shard_map()
         feeds_all = self._norm_feeds(feeds_all)
         p_specs = tuple(P(self.axis_name) for _ in stacked_params)
-        f_specs = jax.tree_util.tree_map(lambda _: P(), feeds_all)
-        pipeline_loss = shard_map(
+        if self.feed_mode == "sharded":
+            self._build_layout(feeds_all)
+            f_specs = P(self.axis_name)
+        else:
+            f_specs = jax.tree_util.tree_map(lambda _: P(), feeds_all)
+        loss_and_grads = _shard_map_unchecked(
             self._body, mesh=self.mesh,
             in_specs=(p_specs, f_specs, P(), P()),
-            out_specs=P())
+            out_specs=(P(self.axis_name), p_specs))
         opt = self.optimizer
 
         def train_step(params, opt_state, feeds, base_rng, step, lr):
-            loss, grads = jax.value_and_grad(
-                lambda ps: pipeline_loss(ps, feeds, base_rng, step)
-            )(params)
+            loss_parts, grads = loss_and_grads(params, feeds, base_rng,
+                                               step)
+            loss = jnp.sum(loss_parts)
             new_p, new_s = [], []
             for p, g, slots in zip(params, grads, opt_state):
                 # stacked [S, ...] leaves: the optimizers are
@@ -151,9 +330,9 @@ class CollectiveGPipe:
         return self._step
 
     def _replicate(self, feeds_all):
-        """Feeds enter the one SPMD program replicated over the stage
-        mesh (each stage reads only its own slice inside). Identity-
-        cached so pinned feeds transfer once, not once per step."""
+        """Replicated feed transport (feed_mode="replicated"): every
+        feed enters the SPMD program on every device. Identity-cached so
+        pinned feeds transfer once, not once per step."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh = NamedSharding(self.mesh, P())
         out = []
@@ -175,9 +354,13 @@ class CollectiveGPipe:
              lr):
         if self._step is None:
             self.build(stacked_params, feeds_all)
+        if self.feed_mode == "sharded":
+            feeds = self._pack_feeds(feeds_all)
+        else:
+            feeds = self._replicate(feeds_all)
         return self._step(tuple(stacked_params), tuple(opt_state),
-                          self._replicate(feeds_all),
-                          base_rng, jnp.int32(step), jnp.float32(lr))
+                          feeds, base_rng, jnp.int32(step),
+                          jnp.float32(lr))
 
     # -- placement helpers ----------------------------------------------
     def place_stacked(self, arrs_by_stage):

@@ -38,12 +38,15 @@ def _prime_factors(n):
     return out
 
 
-def spec_for_status(status, model_axes):
+def spec_for_status(status, model_axes, node=None):
     """Lower a NodeStatus to a PartitionSpec over prime-factored model
     axes; returns None when the status is unmappable (leave unconstrained).
 
     Each split dim claims unused axes whose sizes multiply to its split
-    count; the duplicate (replica) axis stays unsharded.
+    count; the duplicate (replica) axis stays unsharded. Dropping a
+    *distributed* status is numerically safe (XLA picks a layout) but it
+    silently forfeits the memory/compute split the user asked for — so
+    it warns, naming the node and status (VERDICT r5 #7).
     """
     from jax.sharding import PartitionSpec
     if status is None or status.state is None or not status.is_dist():
@@ -59,6 +62,13 @@ def spec_for_status(status, model_axes):
             cand = next((n for n, s in avail.items()
                          if s == p and n not in take), None)
             if cand is None:
+                logger.warning(
+                    "TP constraint dropped: %s wants status %s but the "
+                    "%d-way split has no free mesh axis of size %d in "
+                    "%s — the node runs unconstrained (replicated "
+                    "layout, no memory/compute split)",
+                    node if node is not None else "<node>", status,
+                    parts, p, dict(model_axes))
                 return None
             take.append(cand)
         del_names = list(take)
@@ -163,7 +173,7 @@ def assign_states(eval_node_list, config):
     config.node_status = status
     config.node_spec = {}
     for node, st in status.items():
-        spec = spec_for_status(st, model_axes)
+        spec = spec_for_status(st, model_axes, node=node)
         if spec is not None:
             config.node_spec[node] = spec
     return True

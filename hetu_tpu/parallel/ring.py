@@ -99,21 +99,21 @@ def shard_map_qkv(body_fn, q, k, v, mesh, axis_name, mask=None):
     dim sharded over ``axis_name``; the additive key mask shards on its
     last dim. ``body_fn(q, k, v, mask=...)`` runs per shard."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:                   # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_unchecked
 
     spec = P(None, None, axis_name, None)
     mask_spec = P(None, None, None, axis_name)
+    # unchecked: the causal bodies branch per ring hop (lax.cond), which
+    # jax 0.4.x's replication checker rejects inside shard_map
     if mask is not None:
         body = lambda q_, k_, v_, m_: body_fn(q_, k_, v_, mask=m_)  # noqa: E731
-        return shard_map(body, mesh=mesh,
-                         in_specs=(spec, spec, spec, mask_spec),
-                         out_specs=spec)(q, k, v, mask)
+        return shard_map_unchecked(body, mesh=mesh,
+                                   in_specs=(spec, spec, spec, mask_spec),
+                                   out_specs=spec)(q, k, v, mask)
     body = lambda q_, k_, v_: body_fn(q_, k_, v_)                   # noqa: E731
-    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return shard_map_unchecked(body, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec)(q, k, v)
 
 
 def zigzag_indices(s, n):

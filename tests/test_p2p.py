@@ -70,3 +70,24 @@ def test_owner_of_unmapped_host_raises(monkeypatch):
     assert _owner_of("beta", 2) == 1
     with pytest.raises(ValueError, match="does not map"):
         _owner_of("btea", 2)                  # typo'd yaml fails fast
+
+
+def test_owner_of_rejects_local_nodename_multiproc(monkeypatch):
+    """The local nodename is NOT an accepted stage hostname in
+    multi-process runs (ADVICE r5 #1): rank k's nodename differs from
+    rank j's, so a nodename escape hatch would resolve the same stage
+    to different owners on different ranks and silently split the
+    pipeline. Only rank-invariant names resolve: worker<k>, HETU_HOSTS
+    entries, localhost."""
+    import os
+    monkeypatch.delenv("HETU_HOSTS", raising=False)
+    node = os.uname().nodename
+    if node in ("localhost", "127.0.0.1") or (
+            node.startswith("worker") and node[6:].isdigit()):
+        pytest.skip("host's nodename is itself a mapped name")
+    with pytest.raises(ValueError, match="does not map"):
+        _owner_of(node, 2)
+    # still fine single-process, and when HETU_HOSTS maps it
+    assert _owner_of(node, 1) == 0
+    monkeypatch.setenv("HETU_HOSTS", f"head,{node}")
+    assert _owner_of(node, 2) == 1

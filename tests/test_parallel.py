@@ -120,6 +120,22 @@ def test_spec_lowering():
     assert tuple(spec_for_status(st8, axes)) == (None, ("tp0", "tp1", "tp2"))
 
 
+def test_spec_lowering_warns_on_unmappable(caplog):
+    """A distributed status the planner cannot map is left unconstrained
+    (numerics safe) but must WARN naming the node and status — silently
+    forfeiting the split the user asked for was VERDICT r5 #7."""
+    import logging
+    axes = factorized_axes(4)          # {tp0:2, tp1:2}
+    st = NodeStatus((3, 1))            # 3-way split: no axis of size 3
+    st.get_default()
+    with caplog.at_level(logging.WARNING,
+                         logger="hetu_tpu.parallel.planner"):
+        assert spec_for_status(st, axes, node="MatMulOp(w_proj)") is None
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("MatMulOp(w_proj)" in m and "dropped" in m for m in msgs), \
+        msgs
+
+
 def test_dp_loss_equivalence():
     """8-way data parallelism over the mesh matches single-device: the
     global batch is sharded on dp; grads reduce implicitly in XLA."""
