@@ -122,7 +122,21 @@ def mfu_fields(flops_per_step, sec_per_step):
     return out
 
 
+# every headline metric must carry its own attribution: measured link
+# speed + step-time percentiles (the telemetry PR's bench gate — a
+# ">2x swing" is attributable only when the metric records what the
+# link and the step distribution looked like when it was taken)
+_ATTRIBUTION_FIELDS = ("h2d_MBps", "step_ms_p50", "step_ms_p95")
+
+
 def emit(metric, value, unit, vs, **extra):
+    if unit != "error":
+        missing = [k for k in _ATTRIBUTION_FIELDS if k not in extra]
+        if missing:
+            raise ValueError(
+                f"bench metric {metric!r} emitted without attribution "
+                f"fields {missing}; every metric must carry h2d_MBps "
+                f"and p50/p95 step time (add them, don't drop them)")
     rec = {"metric": metric, "value": round(float(value), 1),
            "unit": unit, "vs_baseline": round(float(vs), 3)}
     for k, v in extra.items():
@@ -130,6 +144,44 @@ def emit(metric, value, unit, vs, **extra):
             v = round(v, 1) if abs(v) >= 10 else round(v, 4)
         rec[k] = v
     print(json.dumps(rec), flush=True)
+
+
+def _pctl(samples_ms):
+    """p50/p95 step-time fields from wall samples (ms). Per-step
+    samples where the bench dispatches per step; for scan-block benches
+    the samples are per-step MEANS of individually-synced blocks (a
+    block is the dispatch unit there — single-step tails inside a
+    compiled scan are not observable from the host)."""
+    a = np.asarray(list(samples_ms), dtype=float)
+    return {"step_ms_p50": round(float(np.percentile(a, 50)), 3),
+            "step_ms_p95": round(float(np.percentile(a, 95)), 3)}
+
+
+def _step_samples(run, sync, n):
+    """n individually-synced run() wall times in ms — the step-time
+    distribution behind the throughput headline (each sample pays one
+    sync, so this runs as a separate pass after the amortized windows,
+    never inside them)."""
+    out = run()
+    sync(out)                             # settle dispatch queue
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = run()
+        sync(out)
+        samples.append((time.perf_counter() - t0) * 1000)
+    return samples
+
+
+def _telemetry():
+    from hetu_tpu import telemetry
+    return telemetry.get_telemetry()
+
+
+def _compiles():
+    """Cumulative jit compile count from the bench-wide telemetry (every
+    executor built by this process feeds the same registry)."""
+    return _telemetry().counter_value("jit_compiles")
 
 
 def h2d_probe_mbps(nbytes=8 << 20, reps=3):
@@ -150,7 +202,9 @@ def h2d_probe_mbps(nbytes=8 << 20, reps=3):
         float(jnp.sum(x))                # force completion via readback
         times.append(time.perf_counter() - t0)
     dt = float(np.median(times[1:]))     # first rep warms the path
-    return nbytes / dt / 1e6
+    mbps = nbytes / dt / 1e6
+    _telemetry().set_gauge("h2d_MBps", mbps)   # scrape-visible link speed
+    return mbps
 
 
 def _pin(feeds):
@@ -206,6 +260,7 @@ def bench_logreg():
     # also divides epoch wall time by batches; per-call latency on a
     # remote tunnel measures the link, not the step
     kblock, steps = 50, 400
+    c0 = _compiles()
     block = [feeds] * kblock
     for _ in range(2):
         out = exe.run_batches(block)
@@ -213,8 +268,12 @@ def bench_logreg():
     best, med = _time_steps(lambda: exe.run_batches(block)[-1],
                             steps // kblock)
     ms = med / steps * 1000
+    blocks = _step_samples(lambda: exe.run_batches(block),
+                           lambda out: out[-1][0].asnumpy(), 6)
     emit("logreg_mnist_step_time", ms, "ms/step", LOGREG_BASELINE_MS / ms,
-         best=best / steps * 1000)
+         best=best / steps * 1000, h2d_MBps=h2d_probe_mbps(),
+         jit_compiles=_compiles() - c0,
+         **_pctl([b / kblock for b in blocks]))
 
 
 def bench_mlp_cifar():
@@ -239,6 +298,7 @@ def bench_mlp_cifar():
                   y_: np.eye(10, dtype="f")[rng.randint(0, 10, batch)]})
     # amortized over scan blocks, like the reference's epoch/batches
     kblock, steps = 50, 400
+    c0 = _compiles()
     block = [feeds] * kblock
     for _ in range(2):
         out = exe.run_batches(block)
@@ -248,8 +308,13 @@ def bench_mlp_cifar():
     ms = med / steps * 1000
     flops = 6.0 * batch * sum(di * do for di, do in
                               zip(dims[:-1], dims[1:]))
+    blocks = _step_samples(lambda: exe.run_batches(block),
+                           lambda out: out[-1][0].asnumpy(), 6)
     emit("mlp_cifar10_step_time", ms, "ms/step", MLP_BASELINE_MS / ms,
-         best=best / steps * 1000, **mfu_fields(flops, med / steps))
+         best=best / steps * 1000, h2d_MBps=h2d_probe_mbps(),
+         jit_compiles=_compiles() - c0,
+         **_pctl([b / kblock for b in blocks]),
+         **mfu_fields(flops, med / steps))
 
 
 def bench_wdl_ps():
@@ -307,6 +372,7 @@ def bench_wdl_ps():
         # warm one full cycle so the measurement sees the steady state
         # (a Criteo epoch is ~350k steps against a table this size; the
         # first-touch miss fills amortize into noise there)
+        c0 = _compiles()
         for i0 in range(0, ncycle + kblock, kblock):
             out = exe.run_batches(block(i0))
         out[-1][0].asnumpy()
@@ -332,6 +398,8 @@ def bench_wdl_ps():
         print(_json.dumps({"metric": "wdl_ps_phase_ms_per_step",
                            "value": breakdown, "unit": "ms/step",
                            "cache": perf}), flush=True)
+        blocks = _step_samples(lambda: exe.run_batches(block(0)),
+                               lambda out: out[-1][0].asnumpy(), 3)
         # headline from the MEDIAN window (round-4 bench-honesty ask);
         # best kept as a field for the steady-state capability
         emit("wdl_criteo_ps_samples_per_sec_per_chip",
@@ -339,6 +407,8 @@ def bench_wdl_ps():
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
+             jit_compiles=_compiles() - c0,
+             **_pctl([b / kblock for b in blocks]),
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()     # drain before the finally block kills the server
     finally:
@@ -387,6 +457,7 @@ def bench_wdl_hybrid():
             return [{dense: dense_in, sparse: zipf[(i0 + j) % ncycle],
                      y_: y_in} for j in range(kblock)]
 
+        c0 = _compiles()
         for i0 in range(0, ncycle + kblock, kblock):
             out = exe.run_batches(block(i0))
         out[-1][0].asnumpy()
@@ -398,11 +469,15 @@ def bench_wdl_hybrid():
                 out = exe.run_batches(block(i0))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
+        blocks = _step_samples(lambda: exe.run_batches(block(0)),
+                               lambda out: out[-1][0].asnumpy(), 3)
         emit("wdl_criteo_hybrid_samples_per_sec_per_chip",
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / WDL_BASELINE_SPS,
              best=float(max(sps_all)), workers=1, servers=1,
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
+             jit_compiles=_compiles() - c0,
+             **_pctl([b / kblock for b in blocks]),
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:
@@ -457,6 +532,7 @@ def bench_ncf():
                      item: items_in[(i0 + j) % ncycle],
                      y_: y_in} for j in range(kblock)]
 
+        c0 = _compiles()
         for i0 in range(0, ncycle + kblock, kblock):
             out = exe.run_batches(block(i0))
         out[-1][0].asnumpy()
@@ -468,11 +544,15 @@ def bench_ncf():
                 out = exe.run_batches(block(i0))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
+        blocks = _step_samples(lambda: exe.run_batches(block(0)),
+                               lambda out: out[-1][0].asnumpy(), 3)
         emit("ncf_ml25m_hybrid_samples_per_sec_per_chip",
              float(np.median(sps_all)), "samples/sec/chip",
              float(np.median(sps_all)) / NCF_BASELINE_SPS,
              best=float(max(sps_all)),
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
+             jit_compiles=_compiles() - c0,
+             **_pctl([b / kblock for b in blocks]),
              note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
         exe.close()
     finally:
@@ -518,14 +598,18 @@ def bench_gcn():
         norm_adj: sp_adj,
     }
     feeds = _pin(feeds)
+    c0 = _compiles()
     for _ in range(3):
         exe.run(feed_dict=feeds)
     steps = 20
     best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps,
                             windows=2)
     ms = med / steps * 1000
+    samples = _step_samples(lambda: exe.run(feed_dict=feeds),
+                            lambda out: out[0].asnumpy(), 8)
     emit("gcn_arxiv_epoch_time", ms, "ms/epoch", GCN_BASELINE_MS / ms,
-         best=best / steps * 1000)
+         best=best / steps * 1000, h2d_MBps=h2d_probe_mbps(),
+         jit_compiles=_compiles() - c0, **_pctl(samples))
 
 
 def gpt_train_flops(batch, seq, hidden, layers, intermediate, vocab):
@@ -566,6 +650,7 @@ def bench_gpt():
     x = rng.randint(0, vocab, (batch, seq_len))
     y = np.concatenate([x[:, 1:], np.full((batch, 1), -1)], axis=1)
     feeds = {ids: jax.device_put(x), labels: jax.device_put(y)}
+    c0 = _compiles()
     for _ in range(3):
         out = exe.run(feed_dict=feeds)
     out[0].asnumpy()
@@ -577,9 +662,12 @@ def bench_gpt():
     dt = time.perf_counter() - t0
     tps = steps * batch * seq_len / dt
     flops = gpt_train_flops(batch, seq_len, 768, 12, 3072, vocab)
+    samples = _step_samples(lambda: exe.run(feed_dict=feeds),
+                            lambda out: out[0].asnumpy(), 8)
     emit("gpt2_small_causal_tokens_per_sec_per_chip", tps,
          "tokens/sec/chip", tps / BERT_BASELINE_TPS,
-         **mfu_fields(flops, dt / steps))
+         h2d_MBps=h2d_probe_mbps(), jit_compiles=_compiles() - c0,
+         **_pctl(samples), **mfu_fields(flops, dt / steps))
 
 
 def bench_bert():
@@ -614,6 +702,7 @@ def bench_bert():
     exe = Executor([loss, train_op], dtype=jnp.bfloat16)
     feeds = _feed_values(feed_nodes, batch, seq_len, vocab)
 
+    c0 = _compiles()
     for _ in range(4):
         out = exe.run(feed_dict=feeds)
     out[0].asnumpy()
@@ -625,8 +714,12 @@ def bench_bert():
     dt = time.perf_counter() - t0
     tps = steps * batch * seq_len / dt
     flops = bert_train_flops(batch, seq_len, 768, 12, 12, 3072, vocab)
+    samples = _step_samples(lambda: exe.run(feed_dict=feeds),
+                            lambda out: out[0].asnumpy(), 10)
     emit("bert_base_mlm_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
-         tps / BERT_BASELINE_TPS, **mfu_fields(flops, dt / steps))
+         tps / BERT_BASELINE_TPS, h2d_MBps=h2d_probe_mbps(),
+         jit_compiles=_compiles() - c0, **_pctl(samples),
+         **mfu_fields(flops, dt / steps))
 
 
 def bench_pp():
@@ -677,6 +770,7 @@ def bench_pp():
     base_ms = base_dt / steps * 1000
 
     x, y_, loss, train_op = build(staged=True)
+    c0 = _compiles()
     exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
     sub = exe.subexecutors["default"]
     assert len(sub.stages) == 2
@@ -688,8 +782,16 @@ def bench_pp():
         "expected co-resident stages to fuse on the 1-chip bench host"
     best, med = _time_steps(lambda: exe.run(feed_dict=feeds), steps)
     ms = med / steps * 1000
+    samples = _step_samples(lambda: exe.run(feed_dict=feeds),
+                            lambda out: out[0].asnumpy(), 10)
+    M, S = 4, 2
+    bubble = (M + S - 1) / M
     emit("pp_gpipe_2stage_step_time", ms, "ms/step", base_ms / ms,
-         best=best / steps * 1000, single_chip_anchor_ms=base_ms)
+         best=best / steps * 1000, single_chip_anchor_ms=base_ms,
+         h2d_MBps=h2d_probe_mbps(), jit_compiles=_compiles() - c0,
+         bubble_factor=round(bubble, 3),
+         pipeline_efficiency=round(base_ms / (ms * bubble), 3),
+         **_pctl(samples))
 
 
 _PP_MODES_SCRIPT = r"""
@@ -742,21 +844,25 @@ def time_exe(exe, x, y_, windows=3):
             out = exe.run(feed_dict=fd)
         np.asarray(out[0].asnumpy())
         times.append((time.perf_counter() - t0) / STEPS * 1000)
-    return min(times), float(np.median(times))
+    return times      # per-window ms/step samples
 
 def time_staged(M):
     x, y_, loss, train = build(NST)
     exe = Executor([loss, train], gpipe=True, num_microbatches=M)
     sub = exe.subexecutors["default"]
-    best, med = time_exe(exe, x, y_)
+    times = time_exe(exe, x, y_)
     assert sub._fused_step is None, "expected the staged (2S-1) path"
-    return best, med
+    return times
 
 def time_coll(M, opts=None, windows=3):
     x, y_, loss, train = build(NST)
     exe = Executor([loss, train], pipeline_mode="collective",
                    num_microbatches=M, pp_options=opts)
     return time_exe(exe, x, y_, windows=windows)
+
+# one recipe for attribution fields everywhere: reuse the parent
+# bench's helpers (the repo is already on sys.path for hetu_tpu)
+from bench import _pctl as pct, h2d_probe_mbps as h2d_mbps
 
 x, y_, loss, train = build(NST, single=True)
 exe = Executor([loss, train])
@@ -771,13 +877,16 @@ np.asarray(out[0].asnumpy())
 single_ms = (time.perf_counter() - t0) / STEPS * 1000
 
 sweep = {}
+sweep_times = {}
 for M in MS:
-    sb, sm = time_staged(M)
-    cb, cm = time_coll(M)
-    sweep[M] = {"staged": round(sb, 2), "collective": round(cb, 2),
-                "staged_median": round(sm, 2),
-                "collective_median": round(cm, 2),
-                "coll_vs_staged": round(sb / cb, 3)}
+    st = time_staged(M)
+    ct = time_coll(M)
+    sweep[M] = {"staged": round(min(st), 2),
+                "collective": round(min(ct), 2),
+                "staged_median": round(float(np.median(st)), 2),
+                "collective_median": round(float(np.median(ct)), 2),
+                "coll_vs_staged": round(min(st) / min(ct), 3)}
+    sweep_times[M] = (st, ct)
 
 # per-variant A/B at the target operating point (each variant is
 # loss-equivalent, asserted by tests/test_collective_pp.py)
@@ -796,8 +905,9 @@ for name, opts in (
         ("default_bf16", {"feed_mode": "sharded", "fuse_ticks": 2,
                           "unroll_fill_drain": True,
                           "boundary_dtype": "bf16"})):
-    ab[name] = round(time_coll(M_AB, opts, windows=2)[0], 2)
+    ab[name] = round(min(time_coll(M_AB, opts, windows=2)), 2)
 
+H2D = round(h2d_mbps(), 1)
 staged_best = sweep[M_HEAD]["staged"]
 coll_best = sweep[M_HEAD]["collective"]
 bubble = (M_HEAD + NST - 1) / M_HEAD
@@ -814,6 +924,7 @@ print(json.dumps({"metric": "pp_gpipe_4stage_staged_step_time",
                   "pipeline_efficiency": round(
                       single_ms / (staged_best * bubble), 3),
                   "m_sweep": {str(m): sweep[m]["staged"] for m in MS},
+                  "h2d_MBps": H2D, **pct(sweep_times[M_HEAD][0]),
                   "platform": "cpu-8dev"}), flush=True)
 print(json.dumps({"metric": "pp_collective_4stage_step_time",
                   "value": coll_best, "unit": "ms/step",
@@ -822,6 +933,7 @@ print(json.dumps({"metric": "pp_collective_4stage_step_time",
                   "staged_anchor_ms": staged_best,
                   "m_sweep": {str(m): sweep[m] for m in MS},
                   "variant_ab_ms_m16": ab,
+                  "h2d_MBps": H2D, **pct(sweep_times[M_HEAD][1]),
                   "platform": "cpu-8dev"}), flush=True)
 print(json.dumps({"metric": "pp_collective_vs_staged_m16",
                   "value": sweep[M_AB]["coll_vs_staged"],
@@ -830,6 +942,7 @@ print(json.dumps({"metric": "pp_collective_vs_staged_m16",
                   "vs_baseline": sweep[M_AB]["coll_vs_staged"],
                   "staged_ms": sweep[M_AB]["staged"],
                   "collective_ms": sweep[M_AB]["collective"],
+                  "h2d_MBps": H2D, **pct(sweep_times[M_AB][1]),
                   "platform": "cpu-8dev"}), flush=True)
 """
 
@@ -851,11 +964,23 @@ def bench_pp_modes():
     import sys
     repo = os.path.dirname(os.path.abspath(__file__))
     env = {**os.environ, "HETU_REPO": repo}
+    # the subprocess computes its own attribution fields; inheriting
+    # HETU_TELEMETRY would make its rank-0 atexit flush clobber the
+    # parent bench's trace_rank0.json in the same directory
+    env.pop("HETU_TELEMETRY", None)
     out = subprocess.run([sys.executable, "-c", _PP_MODES_SCRIPT],
                          env=env, capture_output=True, text=True,
                          timeout=1800)
     metrics = [l for l in out.stdout.splitlines() if l.startswith("{")]
     for line in metrics:
+        # same attribution gate as emit(): a subprocess metric without
+        # h2d/percentile fields must fail loudly, not slip through
+        rec = json.loads(line)
+        missing = [k for k in _ATTRIBUTION_FIELDS if k not in rec]
+        if missing:
+            raise RuntimeError(
+                f"pp-modes metric {rec.get('metric')!r} missing "
+                f"attribution fields {missing}")
         print(line, flush=True)
     if out.returncode != 0 or len(metrics) < 3:
         raise RuntimeError(
@@ -895,6 +1020,7 @@ def bench_bert_long_seq():
     feed_nodes = (input_ids, token_type_ids, attention_mask, mlm_labels,
                   nsp_label)
     feeds = _pin(_feed_values(feed_nodes, batch, seq_len, vocab))
+    c0 = _compiles()
     for _ in range(3):
         out = exe.run(feed_dict=feeds)
     out[0].asnumpy()
@@ -906,14 +1032,26 @@ def bench_bert_long_seq():
     dt = time.perf_counter() - t0
     tps = steps * batch * seq_len / dt
     flops = bert_train_flops(batch, seq_len, 512, 4, 8, 2048, vocab)
+    samples = _step_samples(lambda: exe.run(feed_dict=feeds),
+                            lambda out: out[0].asnumpy(), 8)
     emit("bert_s2048_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
-         tps / BERT_BASELINE_TPS, **mfu_fields(flops, dt / steps))
+         tps / BERT_BASELINE_TPS, h2d_MBps=h2d_probe_mbps(),
+         jit_compiles=_compiles() - c0, **_pctl(samples),
+         **mfu_fields(flops, dt / steps))
 
 
 def main():
     import gc
 
     import jax
+
+    from hetu_tpu import telemetry
+
+    # bench-wide telemetry: every executor this process builds feeds one
+    # registry (jit_compiles / h2d_bytes / step_wall_ms attribution);
+    # HETU_TELEMETRY=<dir> additionally exports the trace + metrics files
+    telemetry.configure(enabled=True, service="bench",
+                        out_dir=os.environ.get("HETU_TELEMETRY"))
 
     for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
                bench_wdl_hybrid, bench_ncf, bench_gcn, bench_pp,
@@ -933,7 +1071,9 @@ def main():
         jax.clear_caches()
     # hard exit: every metric is already flushed, and a lingering
     # non-daemon thread (PS server, tunnel client) must not turn a
-    # finished run into the driver's timeout rc=124 (round-3 postmortem)
+    # finished run into the driver's timeout rc=124 (round-3 postmortem).
+    # os._exit skips atexit, so write the telemetry files explicitly
+    telemetry.get_telemetry().flush()
     import sys
     sys.stdout.flush()
     sys.stderr.flush()

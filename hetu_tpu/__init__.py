@@ -28,6 +28,7 @@ from . import data
 from . import metrics
 from . import launcher
 from . import stream
+from . import telemetry
 
 __version__ = "0.1.0"
 

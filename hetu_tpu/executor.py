@@ -21,8 +21,10 @@ reference's d2h-stream PS path (executor.py:1800-1825).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
+import time
 
 import numpy as np
 
@@ -30,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from . import ndarray
+from . import telemetry as _telemetry
 from .context import (DeviceGroup, get_current_context,
                       get_launch_config_by_traverse_nodes)
 from .graph.autodiff import (find_topo_sort, gradients, sum_node_list,
@@ -102,8 +105,13 @@ class HetuConfig:
                  cache_capacity=None, log_path=None, gpipe=False,
                  pipedream=False, dynamic_memory=False, mesh=None,
                  dtype=None, num_microbatches=None, drain_compress=False,
-                 pipeline_mode=None, pp_options=None):
+                 pipeline_mode=None, pp_options=None, telemetry=None):
         maybe_init_distributed()
+        # unified runtime telemetry (span tracer + metrics registry):
+        # None resolves to the env-driven process default (enabled when
+        # heturun --telemetry exported HETU_TELEMETRY), so launcher-run
+        # scripts trace without code changes; see hetu_tpu/telemetry
+        self.telemetry = _telemetry.resolve(telemetry)
         self.eval_node_list = eval_node_list
         self.train_name = train_name
         self.val_name = val_name
@@ -525,6 +533,24 @@ class SubExecutor:
         donate = (0, 1, 2) if self.training else ()
         return jax.jit(self._build_step(), donate_argnums=donate)
 
+    @contextlib.contextmanager
+    def _compile_span(self, key):
+        """Span + counters around a trace/compile for one feed-shape
+        signature — jit_compiles / jit_compile_ms per shape make a
+        retrace storm (shape churn) visible in the trace instead of
+        showing up only as mysterious slow steps."""
+        tel = self.config.telemetry
+        if not tel.enabled:
+            yield
+            return
+        t0 = tel.clock()
+        yield
+        t1 = tel.clock()
+        tel.complete("jit_compile", t0, t1,
+                     {"subgraph": self.name, "shape_key": str(key)})
+        tel.inc("jit_compiles")
+        tel.observe("jit_compile_ms", (t1 - t0) / 1e6)
+
     def _build_block(self, nsteps):
         """``nsteps`` training steps as ONE compiled program: a lax.scan
         over stacked feeds. Per-invocation dispatch/transfer overhead —
@@ -582,9 +608,10 @@ class SubExecutor:
         by the host-feed path above and the PS runtime's block path)."""
         key = ("block", nsteps) + self._shape_key(first_map)
         if key not in self.compiled:
-            self._infer_shapes(first_map)
-            self._ensure_state(executor)
-            self.compiled[key] = self._build_block(nsteps)
+            with self._compile_span(key):
+                self._infer_shapes(first_map)
+                self._ensure_state(executor)
+                self.compiled[key] = self._build_block(nsteps)
         fn = self.compiled[key]
         feeds = [feed_map[n] for n in self._feed_order()]
         # per-step learning rates: the scheduler advances exactly as it
@@ -596,9 +623,10 @@ class SubExecutor:
                 lrs[k] = np.float32(sched.get())
                 if self.training:
                     sched.step()
-        outs, new_params, new_state, new_opt = fn(
-            executor.params, executor.state, executor.opt_state, feeds,
-            lrs, np.int32(self.step_count), executor.base_rng)
+        with self.config.telemetry.span("block_dispatch"):
+            outs, new_params, new_state, new_opt = fn(
+                executor.params, executor.state, executor.opt_state,
+                feeds, lrs, np.int32(self.step_count), executor.base_rng)
         if self.training:
             executor.params = new_params
             executor.state = new_state
@@ -645,6 +673,10 @@ class SubExecutor:
     def _ingest_stacked(self, arr):
         """Stacked [nsteps, ...] host feed -> device; batch-dim sharding
         applies to dim 1 (dim 0 is the scan axis)."""
+        tel = self.config.telemetry
+        if tel.enabled and not isinstance(arr, jax.Array):
+            tel.inc("h2d_bytes", int(arr.nbytes))
+            tel.instant("h2d_stacked", bytes=int(arr.nbytes))
         sharding = self.config.data_sharding(arr.ndim)
         if sharding is not None and arr.ndim >= 2 and \
                 arr.shape[1] % self.config.nrank == 0:
@@ -691,13 +723,15 @@ class SubExecutor:
 
         key = self._shape_key(feed_map)
         if key not in self.compiled:
-            self._infer_shapes(feed_map)
-            self._ensure_state(executor)
-            self.compiled[key] = self._compile_step()
+            with self._compile_span(key):
+                self._infer_shapes(feed_map)
+                self._ensure_state(executor)
+                self.compiled[key] = self._compile_step()
         fn = self.compiled[key]
 
-        outputs, new_params, new_state, new_opt, _ = fn(
-            *self.trace_args(executor, feed_map))
+        with self.config.telemetry.span("device_dispatch"):
+            outputs, new_params, new_state, new_opt, _ = fn(
+                *self.trace_args(executor, feed_map))
         if self.training:
             executor.params = new_params
             executor.state = new_state
@@ -764,10 +798,19 @@ class SubExecutor:
             value = value.jax_array
         arr = value if isinstance(value, jax.Array) else np.asarray(value)
         sharding = self.config.data_sharding(arr.ndim)
-        if sharding is not None and arr.shape and \
-                arr.shape[0] % self.config.nrank == 0:
-            return jax.device_put(arr, sharding)
-        return jax.device_put(arr)
+        if not (sharding is not None and arr.shape
+                and arr.shape[0] % self.config.nrank == 0):
+            sharding = None     # device_put(x, None) = default placement
+        tel = self.config.telemetry
+        if tel.enabled and not isinstance(arr, jax.Array):
+            # h2d attribution: bytes on the span + running counter (the
+            # transfer itself is async — the span times the dispatch,
+            # the byte counter is what MB/s accounting needs)
+            with tel.span("h2d_transfer", bytes=int(arr.nbytes)):
+                out = jax.device_put(arr, sharding)
+            tel.inc("h2d_bytes", int(arr.nbytes))
+            return out
+        return jax.device_put(arr, sharding)
 
 
 class Executor:
@@ -861,7 +904,10 @@ class Executor:
         self.step_logger = None
         if config.log_path:
             from .profiler import StepLogger
-            self.step_logger = StepLogger(config.log_path)
+            # compat wrapper over the telemetry sink: keeps the JSONL
+            # timeline and mirrors each step into the span trace
+            self.step_logger = StepLogger(config.log_path,
+                                          telemetry=config.telemetry)
 
     @property
     def base_rng(self):
@@ -881,8 +927,17 @@ class Executor:
             name = "default"
         if self.step_logger is not None:
             self.step_logger.begin()
-        out = self.subexecutors[name].run(
-            self, feed_dict, convert_to_numpy_ret_vals)
+        tel = self.config.telemetry
+        if tel.enabled:
+            t0 = time.perf_counter()
+            with tel.span("step", subgraph=name):
+                out = self.subexecutors[name].run(
+                    self, feed_dict, convert_to_numpy_ret_vals)
+            tel.observe("step_wall_ms",
+                        (time.perf_counter() - t0) * 1000.0)
+        else:
+            out = self.subexecutors[name].run(
+                self, feed_dict, convert_to_numpy_ret_vals)
         if self.step_logger is not None:
             self.step_logger.end(self, subgraph=name)
         return out
@@ -1011,13 +1066,16 @@ class Executor:
         return {}
 
     def close(self):
-        """Flush in-flight PS work (ASP pushes, device-cache drains) and
-        release the step logger's file handle."""
+        """Flush in-flight PS work (ASP pushes, device-cache drains),
+        release the step logger's file handle, and write this rank's
+        telemetry files (trace + metrics JSONL) when an output directory
+        is configured."""
         if self.ps_runtime is not None:
             self.ps_runtime.close()
         if self.step_logger is not None:
             self.step_logger.close()
             self.step_logger = None
+        self.config.telemetry.flush()
 
     def __del__(self):
         pass

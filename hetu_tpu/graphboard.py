@@ -9,18 +9,44 @@ an HTML page — plus the .dot source for anyone with graphviz installed.
 Nodes are annotated with the executor's parallel placement: pipeline
 stage (color) and TP PartitionSpec / NodeStatus when the planner
 assigned one.
+
+``costs=`` (the output of ``profiler.profile_ops``, or any
+``{op_name: ms}`` map) overlays per-op cost heat coloring: node fill
+interpolates pale-yellow -> red by cost relative to the most expensive
+op, and the measured ms joins the node's sublabel — the graph view and
+the profiler reading off one artifact.
 """
 from __future__ import annotations
 
 import html
 import os
 
-__all__ = ["show", "render", "close"]
+__all__ = ["show", "render", "close", "to_dot"]
 
 _server = None
 
 _STAGE_COLORS = ["#cfe2f3", "#d9ead3", "#fff2cc", "#f4cccc", "#d9d2e9",
                  "#fce5cd", "#d0e0e3", "#ead1dc"]
+
+
+def _cost_map(costs):
+    """``profile_ops`` output ([(name, ms)]) or a {name: ms} dict ->
+    per-op-name ms (duplicate names sum)."""
+    if not costs:
+        return {}
+    items = costs.items() if isinstance(costs, dict) else costs
+    out = {}
+    for name, ms in items:
+        out[str(name)] = out.get(str(name), 0.0) + float(ms)
+    return out
+
+
+def _heat_color(frac):
+    """0..1 -> pale yellow .. red fill."""
+    lo, hi = (255, 252, 220), (214, 69, 48)
+    frac = min(max(frac, 0.0), 1.0)
+    return "#{:02x}{:02x}{:02x}".format(
+        *(int(round(a + (b - a) * frac)) for a, b in zip(lo, hi)))
 
 
 def _topo(executor):
@@ -59,11 +85,14 @@ def _annotations(executor, topo):
     return out
 
 
-def to_dot(executor):
+def to_dot(executor, costs=None):
     """Graphviz source for the session graph (reference
-    graph2fig.py:11-23 builds the same node/edge list)."""
+    graph2fig.py:11-23 builds the same node/edge list); ``costs``
+    overlays cost heat exactly like ``render``."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
+    cmap = _cost_map(costs)
+    max_cost = max(cmap.values()) if cmap else 0.0
     lines = ["digraph hetu {", "  rankdir=TB;",
              '  node [shape=box, fontsize=10];']
     for node in topo:
@@ -73,8 +102,14 @@ def to_dot(executor):
             label += f"\\nstage {stage}"
         if spec:
             label += f"\\n{spec}"
-        color = _STAGE_COLORS[stage % len(_STAGE_COLORS)] \
-            if stage is not None else "#eeeeee"
+        cost = cmap.get(node.name)
+        if cost is not None:
+            label += f"\\n{cost:.3f} ms"
+            color = _heat_color(cost / max_cost if max_cost else 0.0)
+        elif stage is not None:
+            color = _STAGE_COLORS[stage % len(_STAGE_COLORS)]
+        else:
+            color = "#eeeeee"
         lines.append(f'  n{node.id} [label="{label}", style=filled, '
                      f'fillcolor="{color}"];')
     for node in topo:
@@ -114,11 +149,14 @@ def _layout(topo):
     return coords, order
 
 
-def render(executor, path="graphboard.html"):
+def render(executor, path="graphboard.html", costs=None):
     """Write a standalone HTML/SVG of the graph (plus .dot beside it);
-    returns the html path."""
+    returns the html path. ``costs`` (``profile_ops`` output or a
+    {name: ms} dict) switches node fill to per-op cost heat."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
+    cmap = _cost_map(costs)
+    max_cost = max(cmap.values()) if cmap else 0.0
     coords, order = _layout(topo)
 
     bw, bh, gx, gy = 148, 44, 24, 50
@@ -149,12 +187,20 @@ def render(executor, path="graphboard.html"):
         x, y = coords[node]
         px, py = gx + x * (bw + gx), gy + y * (bh + gy)
         stage, spec = ann[node]
-        fill = _STAGE_COLORS[stage % len(_STAGE_COLORS)] \
-            if stage is not None else "#f5f5f5"
+        cost = cmap.get(node.name)
+        if cost is not None:
+            fill = _heat_color(cost / max_cost if max_cost else 0.0)
+        elif stage is not None:
+            fill = _STAGE_COLORS[stage % len(_STAGE_COLORS)]
+        else:
+            fill = "#f5f5f5"
         title = html.escape(getattr(node, "desc", node.name))
+        if cost is not None:
+            title += html.escape(f" — {cost:.3f} ms")
         sub = " / ".join(x for x in (
             f"stage {stage}" if stage is not None else None,
-            spec) if x)
+            spec,
+            f"{cost:.2f} ms" if cost is not None else None) if x)
         parts.append(
             f'<g><title>{title}</title>'
             f'<rect x="{px}" y="{py}" width="{bw}" height="{bh}" '
@@ -174,14 +220,15 @@ def render(executor, path="graphboard.html"):
     with open(path, "w") as f:
         f.write(page)
     with open(os.path.splitext(path)[0] + ".dot", "w") as f:
-        f.write(to_dot(executor))
+        f.write(to_dot(executor, costs=costs))
     return path
 
 
-def show(executor, path="graphboard.html", port=None):
+def show(executor, path="graphboard.html", port=None, costs=None):
     """Render and (optionally) serve like the reference's graphboard
-    (graph2fig.py:11-33). ``port=None`` skips the server."""
-    out = render(executor, path)
+    (graph2fig.py:11-33). ``port=None`` skips the server; ``costs``
+    (``profile_ops`` output) overlays per-op cost heat coloring."""
+    out = render(executor, path, costs=costs)
     if port is None:
         return out
     import functools

@@ -167,10 +167,13 @@ def _ps_env(cfg, endpoints):
     return env
 
 
-def _spawn_servers(cfg, endpoints, identify=None):
-    """Start every PS server (local fork; ssh for remote hosts)."""
+def _spawn_servers(cfg, endpoints, identify=None, extra_env=None):
+    """Start every PS server (local fork; ssh for remote hosts).
+    ``extra_env`` maps endpoint index -> env dict (telemetry scrape
+    port per server)."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for host, port in endpoints:
+    for i, (host, port) in enumerate(endpoints):
+        senv = (extra_env or {}).get(i, {})
         if _is_local(host):
             pypath = pkg_root + os.pathsep + os.environ.get(
                 "PYTHONPATH", "")
@@ -178,17 +181,19 @@ def _spawn_servers(cfg, endpoints, identify=None):
                 [sys.executable, "-m", "hetu_tpu.ps.run_server",
                  str(port), str(cfg.num_workers)],
                 env={**os.environ, "JAX_PLATFORMS": "cpu",
-                     "PYTHONPATH": pypath})
+                     "PYTHONPATH": pypath, **senv})
         else:
             import shlex
             ssh = ["ssh"] + (["-i", identify] if identify else []) + [host]
             remote = " ".join(shlex.quote(a) for a in [
                 "python3", "-m", "hetu_tpu.ps.run_server",
                 str(port), str(cfg.num_workers)])
+            exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                               for k, v in senv.items())
             # remote spawns need the package on PYTHONPATH too
             p = subprocess.Popen(
                 ssh + [f"env PYTHONPATH={shlex.quote(pkg_root)} "
-                       f"JAX_PLATFORMS=cpu {remote}"])
+                       f"JAX_PLATFORMS=cpu {exports} {remote}"])
         _procs.append(p)
     # wait for every endpoint to accept — remote ones included (a worker
     # whose PSClient connects before its server binds raises immediately)
@@ -214,12 +219,32 @@ def _worker_env(cfg, base_env, rank, coordinator=None):
     return env
 
 
-def launch_command(cfg, command, identify=None):
+def launch_command(cfg, command, identify=None, telemetry=None):
     """Run ``command`` once per worker with the cluster env wired
-    (the ``heturun -c conf.yml python train.py`` path)."""
+    (the ``heturun -c conf.yml python train.py`` path).
+
+    ``telemetry`` (a directory, from ``--telemetry``) turns the unified
+    telemetry layer on fleet-wide: every worker exports per-rank Chrome
+    trace + metrics files there (HETU_TELEMETRY), each PS server serves
+    a Prometheus ``/metrics`` scrape (HETU_TELEMETRY_PORT), and after
+    the workers exit the launcher merges the per-rank traces into ONE
+    Perfetto-loadable ``trace_merged.json``."""
     endpoints = cfg.server_endpoints()
-    _spawn_servers(cfg, endpoints, identify)
+    server_env = {}
+    tdir = None
+    if telemetry:
+        tdir = os.path.abspath(telemetry)
+        os.makedirs(tdir, exist_ok=True)
+        scrape_base = int(os.environ.get("HETU_TELEMETRY_BASE_PORT",
+                                         "18790"))
+        for i, (host, _) in enumerate(endpoints):
+            server_env[i] = {"HETU_TELEMETRY_PORT": str(scrape_base + i)}
+            print(f"telemetry: PS server {i} scrape at "
+                  f"http://{host}:{scrape_base + i}/metrics")
+    _spawn_servers(cfg, endpoints, identify, extra_env=server_env)
     ps_env = _ps_env(cfg, endpoints)
+    if tdir:
+        ps_env["HETU_TELEMETRY"] = tdir
     coordinator = None
     if not cfg.single_host or cfg.spmd:
         # deterministic port: probing the launcher machine says nothing
@@ -272,7 +297,38 @@ def launch_command(cfg, command, identify=None):
         p.wait()
         rc = rc or p.returncode
     _shutdown()
+    if tdir:
+        _merge_telemetry(tdir, cfg.num_workers)
     return rc
+
+
+def _merge_telemetry(tdir, num_workers=None):
+    """Merge per-rank traces into one validated Perfetto file (best
+    effort: a worker that never built an Executor exports nothing).
+    Warns when fewer rank files exist than workers — remote-host ranks
+    write on THEIR filesystem, so a multi-host merge here only covers
+    the launcher-local ranks."""
+    import glob as _glob
+    from .telemetry import merge_traces
+    from .telemetry.check import validate
+    ranks = _glob.glob(os.path.join(tdir, "trace_rank*.json"))
+    if num_workers and len(ranks) < num_workers:
+        print(f"telemetry: WARNING only {len(ranks)}/{num_workers} "
+              f"rank traces present under {tdir} — remote workers "
+              f"export on their own filesystem; the merged trace "
+              f"covers launcher-local ranks only")
+    try:
+        merged = merge_traces(tdir)
+    except ValueError as e:
+        print(f"telemetry: no traces to merge ({e})")
+        return None
+    n, errors = validate(merged)
+    if errors:
+        print(f"telemetry: merged trace INVALID: {errors[:3]}")
+    else:
+        print(f"telemetry: merged trace -> {merged} ({n} events; load "
+              f"it at https://ui.perfetto.dev)")
+    return merged
 
 
 def _launch_worker(target, args, wenv):
@@ -323,6 +379,15 @@ def main(argv=None):
                         help="cluster yaml (nodes: host/servers/workers)")
     parser.add_argument("-i", "--identify", default=None,
                         help="ssh identity file for remote hosts")
+    # DIR is required (no nargs="?"): an optional value in front of the
+    # REMAINDER command would swallow the command's first token as the
+    # directory ("--telemetry python train.py" -> DIR "python")
+    parser.add_argument("--telemetry", default=None, metavar="DIR",
+                        help="enable the unified telemetry layer: "
+                             "per-rank Chrome traces + metrics JSONL "
+                             "under DIR, merged into one Perfetto "
+                             "trace at exit; PS servers serve "
+                             "Prometheus /metrics")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -332,7 +397,8 @@ def main(argv=None):
           f"servers({cfg.num_servers})={cfg.servers} "
           f"workers({cfg.num_workers})={cfg.workers}")
     signal.signal(signal.SIGINT, _shutdown)
-    return launch_command(cfg, args.command, args.identify)
+    return launch_command(cfg, args.command, args.identify,
+                          telemetry=args.telemetry)
 
 
 if __name__ == "__main__":

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .mesh import shard_map_unchecked as _shard_map_unchecked
+from .. import telemetry as _telemetry
 
 __all__ = ["CollectiveGPipe"]
 
@@ -90,7 +91,8 @@ class CollectiveGPipe:
 
     def __init__(self, branches, boundary_aval, num_microbatches, mesh,
                  axis_name, optimizer, feed_mode="sharded", fuse_ticks=2,
-                 unroll_fill_drain=True, boundary_dtype=None):
+                 unroll_fill_drain=True, boundary_dtype=None,
+                 telemetry=None):
         if feed_mode not in ("sharded", "replicated"):
             raise ValueError(
                 f"feed_mode must be 'sharded' or 'replicated', got "
@@ -106,6 +108,8 @@ class CollectiveGPipe:
         self.fuse_ticks = max(1, int(fuse_ticks))
         self.unroll_fill_drain = bool(unroll_fill_drain)
         self.boundary_dtype = _canon_boundary_dtype(boundary_dtype)
+        self.telemetry = (telemetry if telemetry is not None
+                          else _telemetry.NULL)
         self._step = None
         self._feed_cache = {}     # (stage, j) -> (src array, replicated)
         self._packed_cache = None  # (leaf refs, packed [S, row_bytes])
@@ -352,15 +356,37 @@ class CollectiveGPipe:
 
     def step(self, stacked_params, opt_state, feeds_all, base_rng, step,
              lr):
+        tel = self.telemetry
         if self._step is None:
-            self.build(stacked_params, feeds_all)
+            with tel.span("cpp_build"):
+                self.build(stacked_params, feeds_all)
+            tel.inc("jit_compiles")
+        if not tel.enabled:
+            if self.feed_mode == "sharded":
+                feeds = self._pack_feeds(feeds_all)
+            else:
+                feeds = self._replicate(feeds_all)
+            return self._step(tuple(stacked_params), tuple(opt_state),
+                              feeds, base_rng, jnp.int32(step),
+                              jnp.float32(lr))
+        # the whole schedule is ONE program — host-side spans can't see
+        # individual ticks, so the dispatch span carries the tick-loop
+        # structure (fill/steady/drain counts) as attributes instead
         if self.feed_mode == "sharded":
-            feeds = self._pack_feeds(feeds_all)
+            with tel.span("cpp_pack_feeds",
+                          bytes=self.S * self._row_bytes):
+                feeds = self._pack_feeds(feeds_all)
         else:
-            feeds = self._replicate(feeds_all)
-        return self._step(tuple(stacked_params), tuple(opt_state),
-                          feeds, base_rng, jnp.int32(step),
-                          jnp.float32(lr))
+            with tel.span("cpp_replicate_feeds"):
+                feeds = self._replicate(feeds_all)
+        S, M = self.S, self.M
+        fill = S - 1 if self.unroll_fill_drain else 0
+        with tel.span("cpp_dispatch", ticks=M + S - 1, fill=fill,
+                      drain=fill, fuse_ticks=self.fuse_ticks,
+                      stages=S, microbatches=M):
+            return self._step(tuple(stacked_params), tuple(opt_state),
+                              feeds, base_rng, jnp.int32(step),
+                              jnp.float32(lr))
 
     # -- placement helpers ----------------------------------------------
     def place_stacked(self, arrs_by_stage):

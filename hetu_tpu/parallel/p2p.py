@@ -31,6 +31,8 @@ from collections import deque
 
 import numpy as np
 
+from .. import telemetry as _telemetry
+
 __all__ = ["PipeChannel", "get_channel"]
 
 _MAGIC = 0x48503250  # "HP2P"
@@ -139,7 +141,23 @@ class PipeChannel:
     def recv(self, tag, timeout=None):
         """Block until a message tagged ``tag`` arrives; FIFO per tag.
         Default timeout is HETU_PIPE_TIMEOUT_S (600s — the peer may be
-        XLA-compiling its stage block on the first step)."""
+        XLA-compiling its stage block on the first step). With telemetry
+        on, the wait is recorded as a ``p2p_recv`` span with the payload
+        byte count (the cross-rank half of pipeline-bubble accounting;
+        pipeline.py attributes the same wait to its stage)."""
+        tel = _telemetry.get_telemetry()
+        if not tel.enabled:
+            return self._recv(tag, timeout)
+        t0 = tel.clock()
+        arr = self._recv(tag, timeout)
+        t1 = tel.clock()
+        tel.complete("p2p_recv", t0, t1,
+                     {"tag": tag, "bytes": int(arr.nbytes)})
+        tel.inc("p2p_recv_bytes", int(arr.nbytes))
+        tel.observe("p2p_recv_wait_ms", (t1 - t0) / 1e6)
+        return arr
+
+    def _recv(self, tag, timeout=None):
         if timeout is None:
             timeout = float(os.environ.get("HETU_PIPE_TIMEOUT_S", "600"))
         with self._cv:
@@ -185,6 +203,15 @@ class PipeChannel:
             return s
 
     def send(self, dst, tag, arr):
+        tel = _telemetry.get_telemetry()
+        if not tel.enabled:
+            return self._send(dst, tag, arr)
+        nbytes = int(getattr(arr, "nbytes", 0))
+        with tel.span("p2p_send", tag=tag, dst=dst, bytes=nbytes):
+            self._send(dst, tag, arr)
+        tel.inc("p2p_send_bytes", nbytes)
+
+    def _send(self, dst, tag, arr):
         arr = np.ascontiguousarray(arr)
         tb = tag.encode()
         db = arr.dtype.str.encode()

@@ -51,8 +51,11 @@ from ..graph.node import ExecContext
 from ..optimizer import OptimizerOp
 from ..ops.variable import PlaceholderOp
 from ..ops.comm import PipelineSendOp, PipelineReceiveOp
+from .. import telemetry as _telemetry
 
 __all__ = ["PipelineSubExecutor"]
+
+_NULL_CM = _telemetry.NULL.span("")     # shared no-op context manager
 
 
 class _Stage:
@@ -124,24 +127,34 @@ def _device_key(node):
     return ((first.hostname, first.device_id),)
 
 
-def _drive_1f1b(forward, backward, nstages, M):
+def _drive_1f1b(forward, backward, nstages, M, telemetry=None):
     """The 1F1B order: min(nstages, M) warmup forwards, then alternate
     backward/forward, then drain. ONE definition — the in-process,
     fused (trace-time), and cross-process runners all execute exactly
-    this sequence, which is what makes their losses bit-equivalent."""
+    this sequence, which is what makes their losses bit-equivalent.
+    ``telemetry`` (host-driven runners only — the fused runner replays
+    this at trace time where wall clocks mean nothing) brackets the
+    fill / steady-state / drain phases as spans, so the pipeline's
+    bubble structure is visible on the Perfetto timeline."""
     warmup = min(nstages, M)
+    tel = telemetry
+    span = (tel.span if tel is not None and tel.enabled
+            else lambda *a, **k: _NULL_CM)
     done_f = done_b = 0
-    for _ in range(warmup):
-        forward(done_f)
-        done_f += 1
-    while done_f < M:
-        backward(done_b)
-        done_b += 1
-        forward(done_f)
-        done_f += 1
-    while done_b < M:
-        backward(done_b)
-        done_b += 1
+    with span("pp_fill", warmup=warmup):
+        for _ in range(warmup):
+            forward(done_f)
+            done_f += 1
+    with span("pp_steady", ticks=max(M - warmup, 0)):
+        while done_f < M:
+            backward(done_b)
+            done_b += 1
+            forward(done_f)
+            done_f += 1
+    with span("pp_drain", ticks=M - done_b):
+        while done_b < M:
+            backward(done_b)
+            done_b += 1
 
 
 def _owner_of(hostname, nprocs):
@@ -521,8 +534,11 @@ class PipelineSubExecutor:
             if self.schedule == "gpipe":
                 if stage.bwd_block is None:
                     self._make_stage_blocks(stage)
+                    # two jitted programs per stage (fwd/bwd blocks)
+                    self.config.telemetry.inc("jit_compiles", 2)
             elif stage.fwd is None:
                 self._make_stage_fns(stage)
+                self.config.telemetry.inc("jit_compiles", 2)
         # when every stage resolves to the same physical chip (e.g. a
         # pipeline program exercised on one real device), boundary
         # transfers are no-ops and the whole schedule fuses into ONE
@@ -537,6 +553,7 @@ class PipelineSubExecutor:
                 self._build_fused_gpipe()
             else:
                 self._build_fused_1f1b()
+            self.config.telemetry.inc("jit_compiles")
 
     # ------------------------------------------------------------------
     def _build_fused_gpipe(self):
@@ -762,11 +779,42 @@ class PipelineSubExecutor:
             loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
         return self._finish_step(executor, loss, convert_to_numpy_ret_vals)
 
+    def _stage_span(self, name, stage_index):
+        """Span for one stage-level dispatch (no-op when telemetry is
+        off — the kwargs dict only builds on the enabled path)."""
+        tel = self.config.telemetry
+        if not tel.enabled:
+            return _NULL_CM
+        return tel.span(name, stage=stage_index)
+
+    def _recv_traced(self, ch, tag, stage_index):
+        """Blocking channel recv, recorded as that stage's idle (bubble)
+        interval: the time a stage spends waiting on a boundary tensor
+        from another rank IS its pipeline bubble."""
+        tel = self.config.telemetry
+        if not tel.enabled:
+            return ch.recv(tag)
+        t0 = tel.clock()
+        val = ch.recv(tag)
+        t1 = tel.clock()
+        tel.complete("pp_stage_idle", t0, t1,
+                     {"stage": stage_index, "tag": tag,
+                      "bytes": int(val.nbytes)})
+        tel.observe(f"pp_stage{stage_index}_idle_ms", (t1 - t0) / 1e6)
+        return val
+
     def _finish_step(self, executor, loss, convert_to_numpy_ret_vals):
         # the LR scheduler advances once per GLOBAL step under all
         # schedules (pinned semantics; see module docstring)
         self.optimizer.lr_sched.step()
         self.step_count += 1
+        tel = self.config.telemetry
+        if tel.enabled:
+            # analytic GPipe bubble at this (S, M): the inherent
+            # (S-1)/(M+S-1) idle fraction; measured per-stage idle comes
+            # from the pp_stage_idle spans on cross-process runs
+            S, M = len(self.stages), self.num_microbatches
+            tel.observe("pp_bubble_fraction", (S - 1) / (M + S - 1))
         results = []
         for ev in self.eval_nodes:
             results.append(loss if ev is self.loss_node else None)
@@ -838,18 +886,20 @@ class PipelineSubExecutor:
                 ins.append(stage.put(val))
             ins_store[stage.index] = ins
             if stage.consumed_outs:
-                env[stage.index] = stage.fwd_block(
-                    stage.params, ins, stacked_feeds[stage.index],
-                    base_rng, step)
+                with self._stage_span("pp_fwd_block", stage.index):
+                    env[stage.index] = stage.fwd_block(
+                        stage.params, ins, stacked_feeds[stage.index],
+                        base_rng, step)
 
         cot_map = {}    # boundary node -> stacked cotangent (consumer-sum)
         loss_mean = None
         for stage in reversed(self.stages):
             cots = [cot_map.get(n) for n in stage.out_nodes]
-            new_params, new_state, stacked_dins, lm = stage.bwd_block(
-                stage.params, ins_store[stage.index],
-                stacked_feeds[stage.index], base_rng, step, cots,
-                self._stage_opt_state(executor, stage), lr)
+            with self._stage_span("pp_bwd_block", stage.index):
+                new_params, new_state, stacked_dins, lm = stage.bwd_block(
+                    stage.params, ins_store[stage.index],
+                    stacked_feeds[stage.index], base_rng, step, cots,
+                    self._stage_opt_state(executor, stage), lr)
             if lm is not None:
                 loss_mean = lm
             for node, d in zip(stage.in_nodes, stacked_dins):
@@ -978,7 +1028,8 @@ class PipelineSubExecutor:
         opts = dict(getattr(self.config, "pp_options", None) or {})
         cpp = CollectiveGPipe([make_branch(s) for s in range(S)],
                               b_aval, self.num_microbatches, mesh,
-                              "stage", self.optimizer, **opts)
+                              "stage", self.optimizer,
+                              telemetry=self.config.telemetry, **opts)
         self._cpp = cpp
         self._cpp_params = cpp.place_stacked(
             [[executor.params[str(p.id)] for p in st.param_nodes]
@@ -1051,13 +1102,16 @@ class PipelineSubExecutor:
                 if src.owner == self.my_rank:
                     val = env[src.index][src.out_nodes.index(node)]
                 else:
-                    val = ch.recv(f"f{sc}:{node.id}:{stage.index}")
+                    val = self._recv_traced(
+                        ch, f"f{sc}:{node.id}:{stage.index}",
+                        stage.index)
                 ins.append(stage.put(val))
             ins_store[stage.index] = ins
             if stage.consumed_outs:
-                outs = stage.fwd_block(stage.params, ins,
-                                       stacked_feeds[stage.index],
-                                       base_rng, step)
+                with self._stage_span("pp_fwd_block", stage.index):
+                    outs = stage.fwd_block(stage.params, ins,
+                                           stacked_feeds[stage.index],
+                                           base_rng, step)
                 env[stage.index] = outs
                 for node in stage.consumed_outs:
                     val = None
@@ -1081,14 +1135,16 @@ class PipelineSubExecutor:
                 for cons in consumers_of(node):
                     if cons.owner == self.my_rank:
                         continue   # local consumers summed via cot_map
-                    d = stage.put(ch.recv(
-                        f"b{sc}:{node.id}:{cons.index}"))
+                    d = stage.put(self._recv_traced(
+                        ch, f"b{sc}:{node.id}:{cons.index}",
+                        stage.index))
                     c = d if c is None else c + d
                 cots.append(c)
-            new_params, new_state, stacked_dins, lm = stage.bwd_block(
-                stage.params, ins_store[stage.index],
-                stacked_feeds[stage.index], base_rng, step, cots,
-                self._stage_opt_state(executor, stage), lr)
+            with self._stage_span("pp_bwd_block", stage.index):
+                new_params, new_state, stacked_dins, lm = stage.bwd_block(
+                    stage.params, ins_store[stage.index],
+                    stacked_feeds[stage.index], base_rng, step, cots,
+                    self._stage_opt_state(executor, stage), lr)
             if lm is not None:
                 loss_mean = lm
             for node, d in zip(stage.in_nodes, stacked_dins):
@@ -1139,8 +1195,9 @@ class PipelineSubExecutor:
                         val = env_out[(m, src.index)][
                             src.out_nodes.index(node)]
                     else:
-                        val = ch.recv(
-                            f"pf{sc}:{m}:{node.id}:{stage.index}")
+                        val = self._recv_traced(
+                            ch, f"pf{sc}:{m}:{node.id}:{stage.index}",
+                            stage.index)
                     ins.append(stage.put(val))
                 outs = stage.fwd(stage.params, ins,
                                  feeds[stage.index][m], base_rng, step,
@@ -1171,8 +1228,9 @@ class PipelineSubExecutor:
                     for cons in consumers_of(node):
                         if cons.owner == self.my_rank:
                             continue   # local consumers summed in map
-                        d = stage.put(ch.recv(
-                            f"pb{sc}:{m}:{node.id}:{cons.index}"))
+                        d = stage.put(self._recv_traced(
+                            ch, f"pb{sc}:{m}:{node.id}:{cons.index}",
+                            stage.index))
                         c = d if c is None else c + d
                     cots.append(c)
                 dins, new_params, new_state = stage.bwd_apply(
@@ -1201,7 +1259,8 @@ class PipelineSubExecutor:
             for key in [k for k in cot_map if k[0] == m]:
                 del cot_map[key]
 
-        _drive_1f1b(forward, backward, len(self.stages), M)
+        _drive_1f1b(forward, backward, len(self.stages), M,
+                    telemetry=self.config.telemetry)
         if losses:
             return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
         return None
@@ -1252,5 +1311,6 @@ class PipelineSubExecutor:
             for key in [k for k in cot_map if k[0] == m]:
                 del cot_map[key]
 
-        _drive_1f1b(forward, backward, nstages, M)
+        _drive_1f1b(forward, backward, nstages, M,
+                    telemetry=self.config.telemetry)
         return losses           # device values: no host sync per loss

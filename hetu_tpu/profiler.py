@@ -26,14 +26,25 @@ __all__ = ["StepLogger", "profile_ops", "trace"]
 
 class StepLogger:
     """Appends one JSON line per step: wall ms, step index, optional
-    extra phase dict."""
+    extra phase dict. Kept as a compat wrapper over the telemetry layer
+    (hetu_tpu/telemetry): when constructed with a Telemetry instance it
+    mirrors each step into the span trace and the ``step_wall_ms``
+    histogram, so the JSONL timeline and the Perfetto trace agree."""
 
-    def __init__(self, path):
+    def __init__(self, path, telemetry=None):
         self.path = path
         self._f = open(path, "a")
         self._t0 = None
         self._phase_snap = {}
         self.step = 0
+        self.telemetry = telemetry
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def begin(self):
         self._t0 = time.perf_counter()
@@ -41,7 +52,10 @@ class StepLogger:
     def end(self, executor=None, **extra):
         dt = (time.perf_counter() - self._t0) * 1000 \
             if self._t0 is not None else None
-        rec = {"step": self.step, "wall_ms": round(dt, 3) if dt else None}
+        # `dt is not None`, NOT truthiness: a clock-granularity 0.0 ms
+        # step is a real measurement, null means begin() never ran
+        rec = {"step": self.step,
+               "wall_ms": round(dt, 3) if dt is not None else None}
         rt = getattr(executor, "ps_runtime", None) if executor else None
         if rt is not None:
             # rt.times accumulates for the runtime's life: log the DELTA
@@ -54,10 +68,20 @@ class StepLogger:
         rec.update(extra)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
+        tel = self.telemetry
+        if tel is not None and tel.enabled and dt is not None:
+            tel.instant("step_logged", step=self.step,
+                        wall_ms=rec["wall_ms"])
+            tel.observe("steplogger_wall_ms", dt)
         self.step += 1
 
     def close(self):
-        self._f.close()
+        if not self._f.closed:
+            self._f.close()
+
+    @property
+    def closed(self):
+        return self._f.closed
 
 
 def profile_ops(executor, feed_dict=None, name="default", top=20,

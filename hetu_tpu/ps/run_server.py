@@ -1,13 +1,55 @@
 """Server-role entrypoint: ``python -m hetu_tpu.ps.run_server PORT
-NWORKERS`` (the reference's DMLC_ROLE=server process)."""
+NWORKERS`` (the reference's DMLC_ROLE=server process).
+
+With ``HETU_TELEMETRY_PORT`` set (``heturun --telemetry`` exports it),
+the process serves a Prometheus text-format ``/metrics`` scrape on a
+daemon thread beside the native request loop — liveness (uptime),
+identity (port, nworkers, pid) and RSS, so a degraded server host is
+visible to the same scrape infrastructure the workers feed.
+"""
+import os
 import sys
 
 from .native_lib import get_lib
 
 
+def _serve_metrics(ps_port, nworkers):
+    scrape_port = int(os.environ.get("HETU_TELEMETRY_PORT", "0"))
+    if not scrape_port:
+        return None
+    from ..telemetry import MetricsRegistry
+    from ..telemetry.metrics import uptime_gauge
+
+    reg = MetricsRegistry()
+    uptime_gauge(reg, "hetu_ps_server_uptime_seconds")
+    reg.gauge("hetu_ps_server_port").set(ps_port)
+    reg.gauge("hetu_ps_server_nworkers").set(nworkers)
+    reg.gauge("hetu_ps_server_pid").set(os.getpid())
+
+    def _rss_bytes():
+        try:
+            with open("/proc/self/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    reg.gauge("hetu_ps_server_rss_bytes", fn=_rss_bytes)
+    # bind on all interfaces: the scrape may come from another host
+    reg.serve(scrape_port, host="0.0.0.0")
+    return reg
+
+
 def main():
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 18590
     nworkers = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    try:
+        _serve_metrics(port, nworkers)
+    except OSError as e:
+        # observability must never take down the data path: a scrape
+        # port collision (second fleet on the same host) logs and the
+        # PS request loop starts anyway
+        print(f"[hetu-ps] telemetry scrape disabled: {e}",
+              file=sys.stderr)
     sys.exit(get_lib().hetu_ps_run_server(port, nworkers))
 
 

@@ -26,6 +26,7 @@ the reference's asynchronous PS training mode).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 
@@ -102,6 +103,25 @@ class PSRuntime:
         self._register_all()
         import atexit
         atexit.register(self._atexit)
+
+    @contextlib.contextmanager
+    def _phase(self, name):
+        """One PS step phase: accumulates host seconds into the legacy
+        ``times`` counter (StepLogger deltas, bench breakdown) AND — when
+        telemetry is on — emits a ``ps:<name>`` span plus a per-phase
+        latency histogram, so PS RPC cost shows up on the Perfetto
+        timeline next to the device dispatches it delays."""
+        tel = self.config.telemetry
+        t0n = tel.clock() if tel.enabled else 0
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.times[name] += time.perf_counter() - t0
+            if tel.enabled:
+                t1n = tel.clock()
+                tel.complete("ps:" + name, t0n, t1n)
+                tel.observe(f"ps_{name}_ms", (t1n - t0n) / 1e6)
 
     # ------------------------------------------------------------------
     def _register_all(self):
@@ -279,39 +299,42 @@ class PSRuntime:
         # 0. device-cache path: ids -> slots, fill misses/stale rows with
         # async dispatches (data dependency orders them before the step)
         note = []
+        tel = self.config.telemetry
         for rt, ids_node, slots_node in cached:
-            t0 = time.perf_counter()
-            ids = host_ids(ids_node, "device-cached lookup")
-            slots, miss_ids, miss_slots, uniq_slots = rt.assign(
-                ids, functools.partial(self._drain_device_table, rt,
-                                       wait=True))
-            self.times["slot_assign"] += time.perf_counter() - t0
+            with self._phase("slot_assign"):
+                ids = host_ids(ids_node, "device-cached lookup")
+                slots, miss_ids, miss_slots, uniq_slots = rt.assign(
+                    ids, functools.partial(self._drain_device_table, rt,
+                                           wait=True))
             sid = rt.cache_sid
             if len(miss_ids):
-                t0 = time.perf_counter()
-                # a re-missed id whose accumulated grads are still in an
-                # in-flight push would pull a pre-push server value: wait
-                # for that drain first (rare — only evict-then-refault)
-                fut = rt._drain_future
-                inflight = getattr(rt, "_inflight_ids", None)
-                if fut is not None and not fut.done() and \
-                        inflight is not None and \
-                        np.isin(miss_ids, inflight).any():
-                    fut.result()
-                    rt._drain_future = None
-                rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
-                executor.params[sid] = pad_fill(
-                    executor.params[sid], miss_slots, rows, rt.capacity)
-                self.times["miss_fill"] += time.perf_counter() - t0
-            if rt.nworkers > 1:
-                t0 = time.perf_counter()
-                uniq_ids = rt.id_of[uniq_slots]
-                fill_slots, fill_rows = rt.stale_check(uniq_ids, uniq_slots)
-                if fill_slots is not None:
+                if tel.enabled:
+                    tel.inc("dcache_miss_rows", len(miss_ids))
+                with self._phase("miss_fill"):
+                    # a re-missed id whose accumulated grads are still in
+                    # an in-flight push would pull a pre-push server
+                    # value: wait for that drain first (rare — only
+                    # evict-then-refault)
+                    fut = rt._drain_future
+                    inflight = getattr(rt, "_inflight_ids", None)
+                    if fut is not None and not fut.done() and \
+                            inflight is not None and \
+                            np.isin(miss_ids, inflight).any():
+                        fut.result()
+                        rt._drain_future = None
+                    rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
                     executor.params[sid] = pad_fill(
-                        executor.params[sid], fill_slots, fill_rows,
+                        executor.params[sid], miss_slots, rows,
                         rt.capacity)
-                self.times["refresh"] += time.perf_counter() - t0
+            if rt.nworkers > 1:
+                with self._phase("refresh"):
+                    uniq_ids = rt.id_of[uniq_slots]
+                    fill_slots, fill_rows = rt.stale_check(uniq_ids,
+                                                           uniq_slots)
+                    if fill_slots is not None:
+                        executor.params[sid] = pad_fill(
+                            executor.params[sid], fill_slots, fill_rows,
+                            rt.capacity)
             feed_map[slots_node] = sub._ingest(slots)
             if sub.training:
                 note.append((rt, uniq_slots))
@@ -320,19 +343,19 @@ class PSRuntime:
         # prefetch path, EmbeddingLookUp.py:27-40). Duplicate ids in the
         # batch are pulled once and scattered back on the host.
         for lk in sub.ps_lookups:
-            t0 = time.perf_counter()
-            idx = host_ids(lk.inputs[1], "embedding lookup")
-            width = int(lk.inputs[0].shape[-1])
-            cache = self.caches.get(lk.inputs[0].id)
-            if cache is not None:
-                rows = cache.embedding_lookup(idx)
-            else:
-                uniq, inv = np.unique(idx.ravel(), return_inverse=True)
-                rows = client.sparse_pull(
-                    lk.inputs[0].id, uniq, width)[inv].reshape(
-                        idx.shape + (width,))
-            feed_map[lk] = jax.device_put(rows)
-            self.times["host_pull"] += time.perf_counter() - t0
+            with self._phase("host_pull"):
+                idx = host_ids(lk.inputs[1], "embedding lookup")
+                width = int(lk.inputs[0].shape[-1])
+                cache = self.caches.get(lk.inputs[0].id)
+                if cache is not None:
+                    rows = cache.embedding_lookup(idx)
+                else:
+                    uniq, inv = np.unique(idx.ravel(),
+                                          return_inverse=True)
+                    rows = client.sparse_pull(
+                        lk.inputs[0].id, uniq, width)[inv].reshape(
+                            idx.shape + (width,))
+                feed_map[lk] = jax.device_put(rows)
         # explicit sparse-pull ops (inference path, reference
         # ParameterServerCommunicate.py:236-288) feed the same way
         for op in sub.ps_pull_ops:
@@ -341,23 +364,23 @@ class PSRuntime:
             rows = client.sparse_pull(op.parameter.id, idx, width)
             feed_map[op] = jax.device_put(rows)
 
-        t0 = time.perf_counter()
-        key = sub._shape_key(feed_map)
-        if key not in sub.compiled:
-            sub._infer_shapes(feed_map)
-            sub._ensure_state(executor)
-            sub.compiled[key] = sub._compile_step()
-        fn = sub.compiled[key]
-        outputs, new_params, new_state, new_opt, ps_grads = fn(
-            *sub.trace_args(executor, feed_map))
-        if sub.training:
-            executor.params = new_params
-            executor.state = new_state
-            executor.opt_state = new_opt
-            for opt in sub.optimizer_ops:
-                opt.optimizer.lr_sched.step()
-        sub.step_count += 1
-        self.times["dispatch"] += time.perf_counter() - t0
+        with self._phase("dispatch"):
+            key = sub._shape_key(feed_map)
+            if key not in sub.compiled:
+                with sub._compile_span(key):
+                    sub._infer_shapes(feed_map)
+                    sub._ensure_state(executor)
+                    sub.compiled[key] = sub._compile_step()
+            fn = sub.compiled[key]
+            outputs, new_params, new_state, new_opt, ps_grads = fn(
+                *sub.trace_args(executor, feed_map))
+            if sub.training:
+                executor.params = new_params
+                executor.state = new_state
+                executor.opt_state = new_opt
+                for opt in sub.optimizer_ops:
+                    opt.optimizer.lr_sched.step()
+            sub.step_count += 1
 
         # 2. device-cache bookkeeping + periodic drain
         stepped = set()
@@ -388,30 +411,27 @@ class PSRuntime:
                     self._pending_push.append(self._push_pool.submit(
                         self._push_sparse, param, g, nworkers))
                     continue
-                t0 = time.perf_counter()
-                self._push_sparse(param, g, nworkers)
-                client.wait(tid)
-                self.times["sync_push"] += time.perf_counter() - t0
+                with self._phase("sync_push"):
+                    self._push_sparse(param, g, nworkers)
+                    client.wait(tid)
             else:
-                t0 = time.perf_counter()
-                grad = np.asarray(jax.device_get(g)).ravel()
-                if nworkers > 1:
-                    grad = grad / nworkers
-                new_value = client.dd_pushpull(tid, grad)
-                client.wait(tid)
-                sid = str(param.id)
-                if sid in executor.params:
-                    executor.params[sid] = jax.device_put(
-                        new_value.reshape(param.shape))
-                self.times["sync_push"] += time.perf_counter() - t0
+                with self._phase("sync_push"):
+                    grad = np.asarray(jax.device_get(g)).ravel()
+                    if nworkers > 1:
+                        grad = grad / nworkers
+                    new_value = client.dd_pushpull(tid, grad)
+                    client.wait(tid)
+                    sid = str(param.id)
+                    if sid in executor.params:
+                        executor.params[sid] = jax.device_put(
+                            new_value.reshape(param.shape))
 
         # 3b. dense HET drain cadence (grads already accumulated in-graph)
         if self.config.ps_dense_cached and sub.training:
-            t0 = time.perf_counter()
-            self._dense_steps += 1
-            if self._dense_steps >= max(1, self.config.cache_bound):
-                self._drain_dense_cached(nworkers)
-            self.times["dense"] += time.perf_counter() - t0
+            with self._phase("dense"):
+                self._dense_steps += 1
+                if self._dense_steps >= max(1, self.config.cache_bound):
+                    self._drain_dense_cached(nworkers)
 
         # 4. synchronization discipline: BSP barrier or ASP free-running
         # (reference ParameterServerCommunicate.py:226-231)
@@ -477,15 +497,14 @@ class PSRuntime:
                     executor.params[sid] = jax.device_put(
                         value.reshape(param.shape))
 
-        t0 = time.perf_counter()
-        ingested = (pre_ingested if pre_ingested is not None
-                    else self.ingest_feeds(sub, feed_dicts))
-        feed_map = {}
-        first_map = {}
-        for node, (stacked, first) in ingested.items():
-            feed_map[node] = stacked
-            first_map[node] = first
-        self.times["feed_ingest"] += time.perf_counter() - t0
+        with self._phase("feed_ingest"):
+            ingested = (pre_ingested if pre_ingested is not None
+                        else self.ingest_feeds(sub, feed_dicts))
+            feed_map = {}
+            first_map = {}
+            for node, (stacked, first) in ingested.items():
+                feed_map[node] = stacked
+                first_map[node] = first
         for dl in sub.dataloader_ops:
             stacked = np.stack(sub.dl_block(dl, nsteps))
             feed_map[dl] = sub._ingest_stacked(stacked)
@@ -511,55 +530,54 @@ class PSRuntime:
             ids_block[ids_node] = rows
 
         note = []
+        tel = self.config.telemetry
         for rt, ids_node, slots_node in cached:
             # one vectorized assignment for the whole block: the scan
             # threads a single cache array, so the residency set equals
             # per-step assigns with pins held — see assign_block()
-            t0 = time.perf_counter()
-            slots_full, miss_ids, miss_slots, uniq_slots, counts = \
-                rt.assign_block(
-                    np.stack(ids_block[ids_node]),
-                    functools.partial(self._drain_device_table, rt,
-                                      wait=True))
-            self.times["slot_assign"] += time.perf_counter() - t0
+            with self._phase("slot_assign"):
+                slots_full, miss_ids, miss_slots, uniq_slots, counts = \
+                    rt.assign_block(
+                        np.stack(ids_block[ids_node]),
+                        functools.partial(self._drain_device_table, rt,
+                                          wait=True))
             if len(miss_ids):
-                t0 = time.perf_counter()
-                fut = rt._drain_future
-                inflight = getattr(rt, "_inflight_ids", None)
-                if fut is not None and not fut.done() and \
-                        inflight is not None and \
-                        np.isin(miss_ids, inflight).any():
-                    fut.result()
-                    rt._drain_future = None
-                rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
-                executor.params[rt.cache_sid] = pad_fill(
-                    executor.params[rt.cache_sid], miss_slots, rows,
-                    rt.capacity)
-                self.times["miss_fill"] += time.perf_counter() - t0
+                if tel.enabled:
+                    tel.inc("dcache_miss_rows", len(miss_ids))
+                with self._phase("miss_fill"):
+                    fut = rt._drain_future
+                    inflight = getattr(rt, "_inflight_ids", None)
+                    if fut is not None and not fut.done() and \
+                            inflight is not None and \
+                            np.isin(miss_ids, inflight).any():
+                        fut.result()
+                        rt._drain_future = None
+                    rows = client.sparse_pull(rt.tid, miss_ids, rt.width)
+                    executor.params[rt.cache_sid] = pad_fill(
+                        executor.params[rt.cache_sid], miss_slots, rows,
+                        rt.capacity)
             if rt.nworkers > 1:
                 # bounded-staleness refresh; mid-block refreshes would
                 # collapse to this pre-block fill anyway (the compiled
                 # scan never re-reads the server)
-                t0 = time.perf_counter()
-                uniq_ids = rt.id_of[uniq_slots]
-                fill_slots, fill_rows = rt.stale_check(uniq_ids,
-                                                       uniq_slots)
-                if fill_slots is not None:
-                    executor.params[rt.cache_sid] = pad_fill(
-                        executor.params[rt.cache_sid], fill_slots,
-                        fill_rows, rt.capacity)
-                self.times["refresh"] += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            feed_map[slots_node] = sub._ingest_stacked(slots_full)
-            first_map[slots_node] = slots_full[0]
-            if sub.training:
-                note.append((rt, uniq_slots, counts))
-            self.times["slot_assign"] += time.perf_counter() - t0
+                with self._phase("refresh"):
+                    uniq_ids = rt.id_of[uniq_slots]
+                    fill_slots, fill_rows = rt.stale_check(uniq_ids,
+                                                           uniq_slots)
+                    if fill_slots is not None:
+                        executor.params[rt.cache_sid] = pad_fill(
+                            executor.params[rt.cache_sid], fill_slots,
+                            fill_rows, rt.capacity)
+            with self._phase("slot_assign"):
+                feed_map[slots_node] = sub._ingest_stacked(slots_full)
+                first_map[slots_node] = slots_full[0]
+                if sub.training:
+                    note.append((rt, uniq_slots, counts))
 
-        t0 = time.perf_counter()
-        results = sub._dispatch_block(executor, feed_map, first_map,
-                                      nsteps, convert_to_numpy_ret_vals)
-        self.times["dispatch"] += time.perf_counter() - t0
+        with self._phase("dispatch"):
+            results = sub._dispatch_block(executor, feed_map, first_map,
+                                          nsteps,
+                                          convert_to_numpy_ret_vals)
 
         stepped_tables = set()
         for rt, uniq_slots, counts in note:
@@ -593,33 +611,35 @@ class PSRuntime:
                 return              # previous drain still in flight
             fut.result()
             rt._drain_future = None
-        t0 = time.perf_counter()
-        slots, ids, upds = rt.take_dirty()
-        if not len(slots):
-            return
-        executor = self.executor
-        state = executor.state[rt.cache_sid]
-        new_acc, rows_dev, n = pad_gather_zero(
-            state["acc"], slots, rt.capacity,
-            compress=rt.drain_compress)
-        executor.state[rt.cache_sid] = {"acc": new_acc}
-        rt.pushed_rows += n
-        rt._inflight_ids = ids
+        with self._phase("drain_submit"):
+            slots, ids, upds = rt.take_dirty()
+            if not len(slots):
+                return
+            executor = self.executor
+            state = executor.state[rt.cache_sid]
+            new_acc, rows_dev, n = pad_gather_zero(
+                state["acc"], slots, rt.capacity,
+                compress=rt.drain_compress)
+            executor.state[rt.cache_sid] = {"acc": new_acc}
+            rt.pushed_rows += n
+            rt._inflight_ids = ids
+            tel = self.config.telemetry
 
-        def push():
-            rows = np.asarray(jax.device_get(rows_dev))[:n]
-            if rows.dtype != np.float32:
-                rows = rows.astype(np.float32)    # widen bf16 drains
-            if rt.nworkers > 1:
-                rows = rows / rt.nworkers
-            self.client.push_embedding(rt.tid, ids, rows, upds, rt.width)
-            self.client.wait(rt.tid)
+            def push():
+                with tel.span("ps:drain_push", rows=int(n)):
+                    rows = np.asarray(jax.device_get(rows_dev))[:n]
+                    if rows.dtype != np.float32:
+                        rows = rows.astype(np.float32)  # widen bf16
+                    if rt.nworkers > 1:
+                        rows = rows / rt.nworkers
+                    self.client.push_embedding(rt.tid, ids, rows, upds,
+                                               rt.width)
+                    self.client.wait(rt.tid)
 
-        if self._push_pool is not None and not wait:
-            rt._drain_future = self._push_pool.submit(push)
-        else:
-            push()
-        self.times["drain_submit"] += time.perf_counter() - t0
+            if self._push_pool is not None and not wait:
+                rt._drain_future = self._push_pool.submit(push)
+            else:
+                push()
 
     def _drain_dense_cached(self, nworkers, wait=False):
         """Drain the dense HET accumulators: claim each param's HBM grad
@@ -733,6 +753,8 @@ class PSRuntime:
         import atexit
         atexit.unregister(self._atexit)   # don't pin HBM buffers for life
         self.drain()
+        if self.config.telemetry.enabled:
+            self.phase_breakdown()    # final cache-counter gauges
 
     def _atexit(self):
         try:
@@ -748,10 +770,19 @@ class PSRuntime:
             self.times[k] = 0.0
 
     def phase_breakdown(self):
-        """Accumulated per-phase host seconds (bench attribution)."""
+        """Accumulated per-phase host seconds (bench attribution); also
+        publishes the device-cache hit/miss/evict counters as telemetry
+        gauges so a Prometheus scrape sees them."""
         out = dict(self.times)
+        tel = self.config.telemetry
         for rt in self.device_tables.values():
-            out.setdefault("cache_perf", {})[rt.table_node.name] = rt.perf
+            perf = rt.perf
+            out.setdefault("cache_perf", {})[rt.table_node.name] = perf
+            if tel.enabled:
+                for k, v in perf.items():
+                    if isinstance(v, (int, float)):
+                        tel.set_gauge(
+                            f"dcache_{rt.table_node.name}_{k}", v)
         return out
 
     def save(self, path):

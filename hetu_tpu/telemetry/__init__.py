@@ -1,0 +1,202 @@
+"""Unified runtime telemetry: span tracer + metrics registry.
+
+One coherent layer replaces the disconnected shims (StepLogger JSON
+lines, eager ``profile_ops``, the PS runtime's raw ``times`` dict):
+
+* ``Telemetry.span("h2d_transfer", bytes=...)`` — thread-safe span
+  context manager buffered in a bounded ring (tracer.py), exported as
+  Chrome trace-event JSON per rank; ``merge_traces`` stitches per-rank
+  files into ONE Perfetto-loadable timeline (rank -> pid).
+* ``Telemetry.inc/observe/set_gauge`` — counters, gauges, streaming
+  p50/p95/p99 histograms (metrics.py), exportable as JSONL and as a
+  Prometheus text scrape (``MetricsRegistry.serve``).
+* ``python -m hetu_tpu.telemetry.check trace.json`` — schema validator
+  (check.py).
+
+Wiring: ``Executor(..., telemetry=...)`` threads an instance through
+the executor, PS runtime, p2p channel and all pipeline runners; the
+``HETU_TELEMETRY=<dir>`` env (exported by ``heturun --telemetry``)
+enables the process-global default and flushes per-rank files at exit.
+
+Overhead contract: with telemetry disabled the hot path costs ONE
+attribute check + a shared no-op context manager — zero per-step
+allocations (tests/test_telemetry.py pins it). Instrumentation sites
+that would build kwargs dicts guard on ``tel.enabled`` first.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+
+from .tracer import Tracer, merge_traces
+from .metrics import MetricsRegistry, uptime_gauge
+from .check import validate
+
+__all__ = ["Telemetry", "Tracer", "MetricsRegistry", "merge_traces",
+           "validate", "get_telemetry", "configure", "resolve", "NULL"]
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _env_rank():
+    return int(os.environ.get("HETU_PROC_ID",
+                              os.environ.get("HETU_PS_RANK", "0")))
+
+
+class Telemetry:
+    """Facade bundling one Tracer and one MetricsRegistry."""
+
+    def __init__(self, enabled=True, out_dir=None, rank=None,
+                 service=None, trace_capacity=65536):
+        self.enabled = bool(enabled)
+        self.rank = _env_rank() if rank is None else int(rank)
+        self.out_dir = out_dir
+        self.service = service or f"rank{self.rank}"
+        self.tracer = None
+        self.metrics = None
+        self._flushed_paths = []
+        if self.enabled:
+            self.tracer = Tracer(pid=self.rank, capacity=trace_capacity,
+                                 process_name=self.service)
+            self.metrics = MetricsRegistry()
+        if self.enabled and self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            atexit.register(self.flush)
+
+    # -- tracing ---------------------------------------------------------
+    def span(self, name, **args):
+        if not self.enabled:
+            return _NULL_SPAN
+        return self.tracer.span(name, **args)
+
+    def instant(self, name, **args):
+        if self.enabled:
+            self.tracer.instant(name, **args)
+
+    def clock(self):
+        return self.tracer.clock() if self.enabled else 0
+
+    def complete(self, name, t0_ns, t1_ns, args=None):
+        if self.enabled:
+            self.tracer.complete(name, t0_ns, t1_ns, args)
+
+    # -- metrics ---------------------------------------------------------
+    def inc(self, name, n=1):
+        if self.enabled:
+            self.metrics.counter(name).inc(n)
+
+    def observe(self, name, value):
+        if self.enabled:
+            self.metrics.histogram(name).observe(value)
+
+    def set_gauge(self, name, value):
+        if self.enabled:
+            self.metrics.gauge(name).set(value)
+
+    def counter_value(self, name):
+        if not self.enabled:
+            return 0
+        return self.metrics.counter(name).value
+
+    def serve_metrics(self, port, host="127.0.0.1"):
+        if not self.enabled:
+            return None
+        return self.metrics.serve(port, host=host)
+
+    # -- export ----------------------------------------------------------
+    def flush(self):
+        """Write ``trace_rank<r>.json`` + ``metrics_rank<r>.jsonl`` into
+        ``out_dir``; idempotent (atexit + explicit close both call it).
+        Returns the written paths."""
+        if not (self.enabled and self.out_dir):
+            return []
+        trace = os.path.join(self.out_dir,
+                             f"trace_rank{self.rank}.json")
+        self.tracer.export(trace)
+        mpath = os.path.join(self.out_dir,
+                             f"metrics_rank{self.rank}.jsonl")
+        self.metrics.dump_jsonl(mpath)
+        self._flushed_paths = [trace, mpath]
+        return self._flushed_paths
+
+
+NULL = Telemetry(enabled=False)
+
+_default = None
+
+
+def from_env():
+    """Process-global default from the launcher env: enabled (with
+    per-rank files under ``$HETU_TELEMETRY``) when the launcher exported
+    it, the shared disabled singleton otherwise."""
+    out_dir = os.environ.get("HETU_TELEMETRY")
+    if out_dir:
+        return Telemetry(enabled=True, out_dir=out_dir)
+    return NULL
+
+
+def get_telemetry():
+    """The process-global Telemetry (used by components without a config
+    to read from: the p2p channel, the PS server scrape)."""
+    global _default
+    if _default is None:
+        _default = from_env()
+    return _default
+
+
+def configure(enabled=True, out_dir=None, rank=None, service=None):
+    """Install a process-global Telemetry and return it."""
+    global _default
+    _default = Telemetry(enabled=enabled, out_dir=out_dir, rank=rank,
+                         service=service)
+    return _default
+
+
+def resolve(arg):
+    """``Executor(telemetry=...)`` argument -> Telemetry instance.
+
+    None -> the process-global default (env-driven; disabled unless
+    ``HETU_TELEMETRY`` is set). True -> enabled (env out_dir if any).
+    str -> enabled with that output directory. False -> disabled.
+    A Telemetry instance passes through. Enabled instances also become
+    the process-global default so config-less components (p2p channel)
+    attribute into the same trace.
+
+    True/path requests REUSE an enabled default targeting the same
+    out_dir instead of constructing a fresh instance: two instances
+    would share trace_rank<r>.json, and their LIFO atexit flushes would
+    let the OLDER executor's trace overwrite the real run's.
+    """
+    global _default
+    if arg is None:
+        return get_telemetry()
+    if isinstance(arg, Telemetry):
+        tel = arg
+    elif arg is False:
+        return NULL
+    elif arg is True or isinstance(arg, (str, os.PathLike)):
+        out_dir = (os.environ.get("HETU_TELEMETRY") if arg is True
+                   else os.fspath(arg))
+        cur = _default
+        if cur is not None and cur.enabled and cur.out_dir == out_dir:
+            return cur
+        tel = Telemetry(enabled=True, out_dir=out_dir)
+    else:
+        raise TypeError(f"telemetry must be None/bool/path/Telemetry, "
+                        f"got {type(arg).__name__}")
+    if tel.enabled:
+        _default = tel
+    return tel

@@ -1,0 +1,93 @@
+"""Chrome trace-event schema validator:
+
+    python -m hetu_tpu.telemetry.check trace.json [more.json ...]
+
+Used by the tests and as the CI gate on every exported/merged trace:
+exit 0 with an event count when every file validates, exit 1 with the
+first errors otherwise. ``validate()`` is the library form.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+__all__ = ["validate", "main"]
+
+_REQUIRED = ("name", "ph", "ts", "pid", "tid")
+_KNOWN_PH = {"X", "B", "E", "i", "I", "M", "C", "b", "e", "n", "s", "t",
+             "f"}
+
+
+def validate(path):
+    """Validate one trace file; returns (n_events, errors)."""
+    errors = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return 0, [f"{path}: unreadable JSON: {e}"]
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return 0, [f"{path}: no 'traceEvents' list"]
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        return 0, [f"{path}: top level must be an object or array"]
+
+    last_ts = None
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        missing = [k for k in _REQUIRED if k not in ev]
+        if missing:
+            errors.append(f"event {i} ({ev.get('name')!r}): missing "
+                          f"keys {missing}")
+            continue
+        ph = ev["ph"]
+        if ph not in _KNOWN_PH:
+            errors.append(f"event {i}: unknown ph {ph!r}")
+        if not isinstance(ev["ts"], (int, float)) or ev["ts"] < 0:
+            errors.append(f"event {i}: bad ts {ev['ts']!r}")
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"event {i}: 'X' event needs dur >= 0 "
+                              f"(got {dur!r})")
+        if ph != "M":
+            # exporters sort non-metadata events: ts must be monotonic
+            # non-decreasing so Perfetto's sequential parsers stay happy
+            if last_ts is not None and ev["ts"] < last_ts:
+                errors.append(
+                    f"event {i}: ts {ev['ts']} < previous {last_ts} "
+                    f"(non-monotonic)")
+            last_ts = ev["ts"]
+        if len(errors) >= 20:
+            errors.append("... (truncated)")
+            break
+    return len(events), errors
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m hetu_tpu.telemetry.check <trace.json>...",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        n, errors = validate(path)
+        if errors:
+            rc = 1
+            print(f"{path}: INVALID ({len(errors)} errors)")
+            for e in errors:
+                print(f"  {e}")
+        else:
+            print(f"{path}: OK ({n} events)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,34 @@ def test_show_serves(tmp_path):
         graphboard.close()
 
 
+def test_cost_heat_overlay(tmp_path):
+    """graphboard.show(executor, costs=profile_ops(...)) colors nodes by
+    per-op cost and prints the measured ms in the sublabel — the graph
+    view and the profiler reading off one artifact."""
+    from hetu_tpu.profiler import profile_ops
+    from hetu_tpu.graphboard import _heat_color
+
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train])
+    rng = np.random.RandomState(2)
+    feeds = {x: rng.randn(8, 12).astype("f"),
+             y_: np.eye(4, dtype="f")[rng.randint(0, 4, 8)]}
+    exe.run(feed_dict=feeds)
+    costs = profile_ops(exe, feeds, printout=False)
+    out = graphboard.show(exe, str(tmp_path / "h.html"), costs=costs)
+    page = open(out).read()
+    dot = open(str(tmp_path / "h.dot")).read()
+    assert " ms" in page and " ms" in dot
+    # the most expensive op carries the full-heat fill in both artifacts
+    hot = _heat_color(1.0)
+    assert hot in page and hot in dot
+    # a dict {name: ms} works too and drives distinct fills
+    page2 = open(graphboard.render(
+        exe, str(tmp_path / "h2.html"),
+        costs={costs[0][0]: 5.0, costs[-1][0]: 0.5})).read()
+    assert hot in page2 and _heat_color(0.1) in page2
+
+
 def test_pipeline_stage_annotations(tmp_path):
     with ht.context(ht.cpu(0)):
         x = ht.Variable("pb_x", trainable=False)

@@ -36,6 +36,43 @@ def test_step_timeline(tmp_path):
     assert [l["step"] for l in lines] == [0, 1, 2, 3]
 
 
+def test_steplogger_zero_ms_step_is_not_null(tmp_path, monkeypatch):
+    """A legitimate 0.0 ms step (clock granularity) must log as 0.0,
+    not null — `dt is not None`, never truthiness."""
+    from hetu_tpu import profiler
+
+    class _FrozenTime:
+        perf_counter = staticmethod(lambda: 123.456)
+
+    log = str(tmp_path / "zero.jsonl")
+    sl = profiler.StepLogger(log)
+    monkeypatch.setattr(profiler, "time", _FrozenTime)
+    sl.begin()
+    sl.end()
+    sl.close()
+    rec = json.loads(open(log).read())
+    assert rec["wall_ms"] == 0.0
+    # no begin() at all is the only case that logs null
+    sl2 = profiler.StepLogger(log)
+    sl2.end()
+    sl2.close()
+    rec2 = json.loads(open(log).read().splitlines()[-1])
+    assert rec2["wall_ms"] is None
+
+
+def test_steplogger_context_manager_closes(tmp_path):
+    from hetu_tpu.profiler import StepLogger
+
+    log = str(tmp_path / "cm.jsonl")
+    with StepLogger(log) as sl:
+        sl.begin()
+        sl.end()
+        assert not sl.closed
+    assert sl.closed
+    sl.close()          # idempotent
+    assert len(open(log).read().splitlines()) == 1
+
+
 def test_profile_ops_ranks_cost():
     x, y_, loss, train = _mlp()
     exe = Executor([loss, train])
