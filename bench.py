@@ -722,6 +722,228 @@ def bench_bert():
          **mfu_fields(flops, dt / steps))
 
 
+def bench_serving():
+    """Online-inference serving (hetu_tpu/serving/): closed-loop multi-
+    threaded clients against (1) KV-cache GPT decode behind the dynamic
+    micro-batcher — vs_baseline is the measured no-cache full-forward
+    recompute decode, so >1.0 is the KV cache's win — and (2) a
+    PS-backed Wide&Deep model behind the batcher + stdlib HTTP frontend,
+    anchored per-sample against the training-side WDL baseline."""
+    import threading
+
+    import hetu_tpu as ht
+    import hetu_tpu.models as M
+    from hetu_tpu import telemetry as tmod
+    from hetu_tpu.serving import (GPTDecoder, InferenceSession,
+                                  MicroBatcher, ServingHTTPServer,
+                                  next_bucket, serve_embeddings_from_ps)
+
+    tel = _telemetry()
+    if not tel.enabled:
+        tel = tmod.configure(enabled=True, service="bench")
+
+    # ---- 1. GPT decode through the micro-batcher ----------------------
+    vocab, seq, prompt, gen_len = 5000, 128, 16, 32
+    bucket = 8
+    cfg = M.GPTConfig(vocab_size=vocab, hidden_size=256,
+                      num_hidden_layers=4, num_attention_heads=8,
+                      max_position_embeddings=seq,
+                      hidden_dropout_prob=0.0, use_flash_attention=True)
+    model = M.GPTLMHeadModel(cfg)
+    ids = ht.Variable("input_ids", trainable=False)
+    logits = model(ids)
+    sess = InferenceSession([logits], seq_buckets=(seq,), telemetry=tel)
+    dec = GPTDecoder.from_session(sess, cfg, telemetry=tel)
+    rng = np.random.RandomState(0)
+    warm = rng.randint(0, vocab, (bucket, prompt))
+    # warm EVERY batch bucket the closed loop can hit (ticks coalesce
+    # 1..bucket rows -> serve_decode pads to {1,2,4,8}): compiles must
+    # not land inside the timed window
+    b = 1
+    while b <= bucket:
+        dec.generate(warm[:b], 2)
+        b *= 2
+
+    # no-cache anchor: decode by full-sequence recompute (argmax chain)
+    cur = warm
+    sess.predict({ids: cur})            # warm the bucketed full forward
+    t0 = time.perf_counter()
+    naive_steps = 4
+    for _ in range(naive_steps):
+        full = sess.predict({ids: cur})[0]
+        nxt = np.argmax(full[:, -1], axis=-1)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    naive_tps = naive_steps * bucket / (time.perf_counter() - t0)
+
+    # per-decode-step latency distribution (the serving "step time")
+    _, kv = dec.prefill(warm)
+    tok = warm[:, -1]
+    step_samples = []
+    for t in range(20):
+        t0 = time.perf_counter()
+        last, kv = dec.decode_step(kv, tok, prompt + t)
+        tok = np.argmax(np.asarray(last), axis=-1)   # sync + next token
+        step_samples.append((time.perf_counter() - t0) * 1000)
+
+    def serve_decode(feeds):
+        x = feeds["ids"]
+        n = len(x)
+        b = next_bucket(n)
+        if b > n:                       # keep decode compiles bucketed
+            x = np.concatenate([x, np.repeat(x[-1:], b - n, axis=0)])
+        return dec.generate(x, gen_len)[:n]
+
+    nclients, per_client = 4, 6
+    latencies = []
+    errors = []
+    with MicroBatcher(serve_decode, max_batch_size=bucket, max_wait_ms=5,
+                      telemetry=tel, name="gpt_serve") as mb:
+        def decode_client(k):
+            crng = np.random.RandomState(100 + k)
+            try:
+                for _ in range(per_client):
+                    p = crng.randint(0, vocab, (1, prompt))
+                    t0 = time.perf_counter()
+                    out = mb.submit({"ids": p}).result(120)
+                    latencies.append((time.perf_counter() - t0) * 1000)
+                    assert out.shape == (1, gen_len)
+            except Exception as e:                  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=decode_client, args=(k,))
+                   for k in range(nclients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    nreq = nclients * per_client
+    kv_tps = nreq * gen_len / wall
+    snap = {s["name"]: s for s in tel.metrics.snapshot()}
+    occ = snap.get("gpt_serve_batch_occupancy", {}).get("mean", 0.0)
+    emit("serving_gpt_decode_requests_per_s", nreq / wall, "req/s",
+         kv_tps / naive_tps if naive_tps else 0.0,
+         decode_tokens_per_s=round(kv_tps, 1),
+         no_cache_tokens_per_s=round(naive_tps, 1),
+         serve_latency_ms_p50=round(float(np.percentile(latencies, 50)), 2),
+         serve_latency_ms_p95=round(float(np.percentile(latencies, 95)), 2),
+         batch_occupancy=round(float(occ), 3), clients=nclients,
+         prompt=prompt, gen=gen_len, h2d_MBps=h2d_probe_mbps(),
+         **_pctl(step_samples))
+    sess.close()
+
+    # ---- 2. PS-backed CTR behind batcher + HTTP ------------------------
+    import json as _json
+    import urllib.request
+
+    from hetu_tpu.models.ctr import wdl_adult
+    from hetu_tpu.ps import client as ps_client
+    from hetu_tpu.ps import server as ps_server
+
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    ps_client.set_default_client(client)
+    try:
+        rng = np.random.RandomState(1)
+        dense = ht.Variable("dense_input", trainable=False)
+        sparse = ht.Variable("sparse_input", trainable=False)
+        y_ = ht.Variable("y_", trainable=False)
+        loss, y, y_, train_op = wdl_adult(dense, sparse, y_)
+        from hetu_tpu.executor import Executor
+        exe = Executor([loss, train_op], comm_mode="PS")
+        for _ in range(2):      # registers + trains the table on the PS
+            exe.run(feed_dict={
+                dense: rng.randn(32, 6).astype("f"),
+                sparse: rng.randint(0, 50000, (32, 8)),
+                y_: np.eye(2, dtype="f")[rng.randint(0, 2, 32)]})
+        exe.close()
+
+        eval_nodes = [y]
+        serve_embeddings_from_ps(eval_nodes)
+        sess2 = InferenceSession(eval_nodes, comm_mode="PS",
+                                 embed_cache_rows=1 << 16, telemetry=tel)
+        # step-time distribution of the serving forward at full batch
+        feed64 = {"dense_input": rng.randn(64, 6).astype("f"),
+                  "sparse_input": rng.randint(0, 50000, (64, 8))}
+        # warm every bucket the 1-4-row client requests can coalesce to
+        n = 1
+        while n <= 16:
+            sess2.predict({"dense_input": feed64["dense_input"][:n],
+                           "sparse_input": feed64["sparse_input"][:n]})
+            n *= 2
+        sess2.predict(feed64)
+        ctr_steps = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            sess2.predict(feed64)
+            ctr_steps.append((time.perf_counter() - t0) * 1000)
+
+        latencies2 = []
+        errors2 = []
+        rows_served = [0]
+        with MicroBatcher(sess2.predict, max_batch_size=64, max_wait_ms=2,
+                          telemetry=tel, name="ctr_serve") as mb2, \
+                ServingHTTPServer(mb2, telemetry=tel) as srv:
+            def ctr_client(k):
+                crng = np.random.RandomState(200 + k)
+                try:
+                    for i in range(25):
+                        n = int(crng.randint(1, 5))
+                        body = _json.dumps({"inputs": {
+                            "dense_input":
+                                crng.randn(n, 6).astype("f").tolist(),
+                            "sparse_input":
+                                crng.randint(0, 50000, (n, 8)).tolist(),
+                        }}).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{srv.port}/v1/predict",
+                            body, {"Content-Type": "application/json"})
+                        t0 = time.perf_counter()
+                        resp = _json.loads(urllib.request.urlopen(
+                            req, timeout=120).read())
+                        latencies2.append(
+                            (time.perf_counter() - t0) * 1000)
+                        assert len(resp["outputs"][0]) == n
+                        rows_served[0] += n
+                except Exception as e:              # noqa: BLE001
+                    errors2.append(e)
+
+            threads = [threading.Thread(target=ctr_client, args=(k,))
+                       for k in range(4)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        if errors2:
+            raise errors2[0]
+        nreq2 = 4 * 25
+        sps = rows_served[0] / wall
+        snap = {s["name"]: s for s in tel.metrics.snapshot()}
+        occ = snap.get("ctr_serve_batch_occupancy", {}).get("mean", 0.0)
+        emit("serving_wdl_ps_requests_per_s", nreq2 / wall, "req/s",
+             sps / WDL_BASELINE_SPS, samples_per_s=round(sps, 1),
+             serve_latency_ms_p50=round(
+                 float(np.percentile(latencies2, 50)), 2),
+             serve_latency_ms_p95=round(
+                 float(np.percentile(latencies2, 95)), 2),
+             batch_occupancy=round(float(occ), 3),
+             embed_cache_hit_rate=round(sess2.ps_client.hit_rate, 4),
+             clients=4, h2d_MBps=h2d_probe_mbps(), **_pctl(ctr_steps))
+        sess2.close()
+    finally:
+        client.shutdown_servers()
+        ps_client.close_default_client()
+        ps_server.shutdown_server()
+
+
 def bench_pp():
     """Pipeline-parallel step-time microbench: 2-stage GPipe MLP, 4
     microbatches, compiled schedule. On this one-chip bench host
@@ -1053,10 +1275,24 @@ def main():
     telemetry.configure(enabled=True, service="bench",
                         out_dir=os.environ.get("HETU_TELEMETRY"))
 
-    for fn in (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
-               bench_wdl_hybrid, bench_ncf, bench_gcn, bench_pp,
-               bench_pp_modes, bench_bert_long_seq, bench_gpt,
-               bench_bert):
+    units = (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
+             bench_wdl_hybrid, bench_ncf, bench_gcn, bench_serving,
+             bench_pp, bench_pp_modes, bench_bert_long_seq, bench_gpt,
+             bench_bert)
+    # `python bench.py serving gpt` runs just those units (name match
+    # against bench_<arg>); no args = the full suite, headline last
+    import sys
+    args = [a.lower() for a in sys.argv[1:]]
+    if args:
+        names = {fn.__name__.replace("bench_", ""): fn for fn in units}
+        unknown = [a for a in args if a not in names]
+        if unknown:
+            raise SystemExit(
+                f"unknown bench unit(s) {unknown}; units: "
+                + ", ".join(names))
+        units = tuple(fn for fn in units
+                      if fn.__name__.replace("bench_", "") in args)
+    for fn in units:
         try:
             fn()
         except Exception as e:                      # noqa: BLE001

@@ -33,6 +33,15 @@ from . import telemetry
 __version__ = "0.1.0"
 
 
+def __getattr__(name):
+    # lazy: serving pulls in the model zoo; training-only scripts
+    # shouldn't pay for it at import time
+    if name == "serving":
+        import importlib
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def mpi_nccl_init(init_nccl=True):
     """Reference-compat: returns (comm, device_id)."""
     comm = wrapped_mpi_nccl_init(init_nccl)

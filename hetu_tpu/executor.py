@@ -1027,6 +1027,17 @@ class Executor:
         """One .npy per trainable parameter (reference executor.py:376-434)
         plus optimizer slots / step counters in a sidecar pickle."""
         os.makedirs(file_path, exist_ok=True)
+        # files key by node.name: a duplicate name would silently
+        # overwrite another parameter's .npy — fail at save time
+        by_name = {}
+        for sid, node in self._param_nodes.items():
+            if node.name in by_name:
+                raise ValueError(
+                    f"cannot save: two parameters share the name "
+                    f"{node.name!r} (node ids {by_name[node.name]} and "
+                    f"{sid}) — their .npy files would overwrite each "
+                    f"other; give the variables distinct names")
+            by_name[node.name] = sid
         for sid, node in self._param_nodes.items():
             np.save(os.path.join(file_path, node.name + ".npy"),
                     np.asarray(self.params[sid]))
@@ -1043,22 +1054,46 @@ class Executor:
             self.ps_runtime.save(file_path)
 
     def load(self, file_path, file_name=None):
+        import warnings
         for sid, node in self._param_nodes.items():
             path = os.path.join(file_path, node.name + ".npy")
             if os.path.exists(path):
                 value = np.load(path)
                 self.params[sid] = jax.device_put(
                     value, self.params[sid].sharding)
+            else:
+                warnings.warn(
+                    f"checkpoint {file_path} has no file for parameter "
+                    f"{node.name!r} ({node.name}.npy); keeping its "
+                    f"current value", stacklevel=2)
         ckpt = os.path.join(file_path, file_name or "session.ckpt")
         if os.path.exists(ckpt):
             with open(ckpt, "rb") as f:
                 sidecar = pickle.load(f)
-            self.opt_state = jax.tree_util.tree_map(
-                jnp.asarray, sidecar["opt_state"])
-            self.state = jax.tree_util.tree_map(
-                jnp.asarray, sidecar["state"])
+            # restore with the PRE-load shardings: a bare jnp.asarray
+            # would commit multi-device opt state to device 0 and every
+            # later donated update would pay a reshard
+            self.opt_state = self._restore_like(sidecar["opt_state"],
+                                                self.opt_state)
+            self.state = self._restore_like(sidecar["state"], self.state)
         if self.ps_runtime is not None:
             self.ps_runtime.load(file_path)
+
+    @staticmethod
+    def _restore_like(new_tree, old_tree):
+        """Device-put a checkpointed pytree using the current tree's
+        leaf shardings; falls back to default placement for leaves (or
+        whole trees) the current session doesn't have."""
+        def put(value, like):
+            sharding = getattr(like, "sharding", None)
+            try:
+                return jax.device_put(np.asarray(value), sharding)
+            except ValueError:      # shape/sharding mismatch
+                return jnp.asarray(value)
+        try:
+            return jax.tree_util.tree_map(put, new_tree, old_tree)
+        except ValueError:          # tree structures diverged
+            return jax.tree_util.tree_map(jnp.asarray, new_tree)
 
     def recordLoads(self):
         if self.config.ps_comm is not None:

@@ -25,7 +25,9 @@ from ..ops.variable import Variable
 from .bert import (BertLayerNorm as LayerNorm, Dropout, Embedding,
                    Linear, _act)
 
-__all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel"]
+__all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel",
+           "gpt_param_names", "gpt_serving_params", "init_kv_cache",
+           "gpt_prefill", "gpt_cached_step"]
 
 
 class GPTConfig:
@@ -51,6 +53,153 @@ class GPTConfig:
         if sequence_parallel is True:
             sequence_parallel = "ring"
         self.sequence_parallel = sequence_parallel or None
+
+
+def gpt_param_names(config):
+    """Checkpoint layout of a ``GPTLMHeadModel``: the parameter NAMES the
+    builders above assign, structured the way the serving forward wants
+    them. ``Executor.save`` writes one ``<name>.npy`` per parameter, so
+    this is the bridge from a training checkpoint (or a live executor's
+    ``params``) to the pure-JAX cached decode below — no re-tracing of
+    the graph, just a name lookup."""
+    blocks = []
+    for i in range(config.num_hidden_layers):
+        p = f"gpt_h{i}"
+        blocks.append({
+            "ln1": (f"{p}_ln1_scale", f"{p}_ln1_bias"),
+            "qkv": (f"{p}_attn_qkv_weights", f"{p}_attn_qkv_bias"),
+            "proj": (f"{p}_attn_proj_weights", f"{p}_attn_proj_bias"),
+            "ln2": (f"{p}_ln2_scale", f"{p}_ln2_bias"),
+            "fc": (f"{p}_mlp_fc_weights", f"{p}_mlp_fc_bias"),
+            "mlp_proj": (f"{p}_mlp_proj_weights", f"{p}_mlp_proj_bias"),
+        })
+    return {"wte": "gpt_wte", "wpe": "gpt_wpe", "blocks": blocks,
+            "ln_f": ("gpt_ln_f_scale", "gpt_ln_f_bias"),
+            "lm_head": "gpt_lm_head_weights"}
+
+
+def gpt_serving_params(config, lookup):
+    """Assemble the cached-forward parameter pytree. ``lookup(name)``
+    returns the array for one checkpoint name (a dict's ``__getitem__``,
+    an ``np.load`` closure over a checkpoint dir, ...)."""
+    import jax.numpy as jnp
+
+    def get(name):
+        return jnp.asarray(lookup(name), jnp.float32)
+
+    names = gpt_param_names(config)
+    out = {"wte": get(names["wte"]), "wpe": get(names["wpe"]),
+           "ln_f": tuple(get(n) for n in names["ln_f"]),
+           "lm_head": get(names["lm_head"]), "blocks": []}
+    for blk in names["blocks"]:
+        out["blocks"].append(
+            {k: tuple(get(n) for n in v) for k, v in blk.items()})
+    return out
+
+
+def init_kv_cache(config, batch, max_len=None):
+    """Preallocated zero K/V buffers, one ``[B, H, S_max, D]`` pair per
+    layer — the decode loop write-indexes rows in place (donated, so the
+    update is in-HBM)."""
+    import jax.numpy as jnp
+    nh = config.num_attention_heads
+    hs = config.hidden_size // nh
+    s_max = int(max_len or config.max_position_embeddings)
+    shape = (int(batch), nh, s_max, hs)
+    return [{"k": jnp.zeros(shape, jnp.float32),
+             "v": jnp.zeros(shape, jnp.float32)}
+            for _ in range(config.num_hidden_layers)]
+
+
+def _serve_ln(x, scale_bias, eps=1e-12):
+    import jax.numpy as jnp
+    scale, bias = scale_bias
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps)) * scale + bias
+
+
+def _serve_act(name):
+    """Serving-side activation matching the graph builders' ``_act``
+    (ops/activations.py numerics: tanh-approx gelu)."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        return {"gelu": lambda x: jax.nn.gelu(x, approximate=True),
+                "relu": jax.nn.relu, "tanh": jnp.tanh}[name]
+    except KeyError:
+        raise ValueError(
+            f"unsupported hidden_act for the serving forward: {name!r} "
+            f"(gelu/relu/tanh)") from None
+
+
+def _serve_mlp(x, blk, act):
+    h = act(x @ blk["fc"][0] + blk["fc"][1])
+    return h @ blk["mlp_proj"][0] + blk["mlp_proj"][1]
+
+
+def gpt_prefill(params, kv, ids, num_heads, hidden_act="gelu"):
+    """Prompt phase: full causal forward over ``ids`` ``[B, S0]`` that
+    also writes rows ``0..S0-1`` of every layer's K/V cache. Attention
+    rides :func:`hetu_tpu.ops.attention.prefill_attention` (the Pallas
+    flash kernel on TPU). Returns ``(logits [B, S0, V], kv)``."""
+    from ..ops.attention import prefill_attention
+
+    act = _serve_act(hidden_act)
+    b, s0 = ids.shape
+    hidden = params["wte"].shape[1]
+    hs = hidden // num_heads
+    x = params["wte"][ids] + params["wpe"][:s0][None]
+    new_kv = []
+    for blk, layer in zip(params["blocks"], kv):
+        h = _serve_ln(x, blk["ln1"])
+        qkv = h @ blk["qkv"][0] + blk["qkv"][1]            # [B, S0, 3H]
+        q, k, v = (qkv[..., i * hidden:(i + 1) * hidden]
+                   .reshape(b, s0, num_heads, hs).transpose(0, 2, 1, 3)
+                   for i in range(3))
+        k_cache = layer["k"].at[:, :, :s0, :].set(k)
+        v_cache = layer["v"].at[:, :, :s0, :].set(v)
+        new_kv.append({"k": k_cache, "v": v_cache})
+        ctx = prefill_attention(q, k, v, sm_scale=1.0 / float(np.sqrt(hs)),
+                                causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s0, hidden)
+        x = x + (ctx @ blk["proj"][0] + blk["proj"][1])
+        x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
+    x = _serve_ln(x, params["ln_f"])
+    return x @ params["lm_head"], new_kv
+
+
+def gpt_cached_step(params, kv, tokens, pos, num_heads,
+                    hidden_act="gelu"):
+    """Cached single-token forward: ``tokens`` ``[B]`` at position
+    ``pos`` (traced int32 scalar). Writes row ``pos`` of every layer's
+    K/V buffer and attends over rows ``0..pos`` via
+    :func:`~hetu_tpu.ops.attention.decode_attention` — per step cost is
+    O(S_max) row reads, no ``[S, S]`` mask, position-indexed learned
+    embeddings. Returns ``(logits [B, V], kv)``. jit with the kv
+    argument donated so the cache updates in place in HBM."""
+    from ..ops.attention import decode_attention
+
+    act = _serve_act(hidden_act)
+    hidden = params["wte"].shape[1]
+    hs = hidden // num_heads
+    b = tokens.shape[0]
+    x = params["wte"][tokens] + params["wpe"][pos]          # [B, H]
+    new_kv = []
+    for blk, layer in zip(params["blocks"], kv):
+        h = _serve_ln(x, blk["ln1"])
+        qkv = h @ blk["qkv"][0] + blk["qkv"][1]             # [B, 3H]
+        q, k, v = (qkv[:, i * hidden:(i + 1) * hidden]
+                   .reshape(b, num_heads, hs) for i in range(3))
+        k_cache = layer["k"].at[:, :, pos, :].set(k)
+        v_cache = layer["v"].at[:, :, pos, :].set(v)
+        new_kv.append({"k": k_cache, "v": v_cache})
+        ctx = decode_attention(q, k_cache, v_cache, pos,
+                               sm_scale=1.0 / float(np.sqrt(hs)))
+        x = x + (ctx.reshape(b, hidden) @ blk["proj"][0] + blk["proj"][1])
+        x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
+    x = _serve_ln(x, params["ln_f"])
+    return x @ params["lm_head"], new_kv
 
 
 class CausalSelfAttention:

@@ -22,7 +22,8 @@ from ..graph.node import Op
 
 __all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference",
            "ring_attention_op", "RingAttentionOp",
-           "ulysses_attention_op", "UlyssesAttentionOp"]
+           "ulysses_attention_op", "UlyssesAttentionOp",
+           "decode_attention", "prefill_attention"]
 
 
 def attention_reference(q, k, v, mask, sm_scale):
@@ -37,6 +38,46 @@ def attention_reference(q, k, v, mask, sm_scale):
 # sequence length above which the fused Pallas backward beats XLA's
 # composed vjp (below it the S^2 intermediates fit on-chip anyway)
 FUSED_BWD_MIN_SEQ = 512
+
+
+# ---------------------------------------------------------------------------
+# serving decode helpers (pure JAX, no graph nodes) — the index path the
+# KV-cache single-token forward rides (models/gpt.py, serving/decode.py)
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_cache, v_cache, pos, sm_scale):
+    """One query token against a preallocated KV cache.
+
+    ``q`` is ``[B, H, D]`` (the current position's query), ``k_cache`` /
+    ``v_cache`` are ``[B, H, S_max, D]`` with rows ``0..pos`` written and
+    the rest zero; ``pos`` is the 0-based position of the current token.
+    Returns ``[B, H, D]``. Causality is a length-``S_max`` validity
+    vector — no ``[S, S]`` mask ever materializes, and the cost per step
+    is O(S_max * D) instead of the full forward's O(S^2 * D)."""
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhd,bhsd->bhs", q * sm_scale, k_cache)
+    valid = jnp.arange(s_max) <= pos
+    scores = jnp.where(valid[None, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", probs.astype(v_cache.dtype),
+                      v_cache)
+
+
+def prefill_attention(q, k, v, sm_scale, causal=True):
+    """Dense prompt-phase attention for the serving decode path over
+    ``[B, H, S, D]`` q/k/v: rides the Pallas flash kernel on TPU
+    backends (blocked online softmax, no HBM score matrix), the
+    composed reference elsewhere."""
+    if _use_pallas():
+        from .pallas_attention import flash_attention
+        return flash_attention(q, k, v, None, sm_scale=sm_scale,
+                               causal=causal)
+    mask = None
+    if causal:
+        s = q.shape[-2]
+        mask = jnp.where(jnp.tril(jnp.ones((s, s), bool)), 0.0,
+                         -1e9)[None, None]
+    return attention_reference(q, k, v, mask, sm_scale)
 
 
 def _use_pallas():

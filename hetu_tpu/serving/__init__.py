@@ -1,0 +1,36 @@
+"""Online inference: the subsystem that turns a trained checkpoint into
+something that answers requests.
+
+The training stack (executor/PS/telemetry) already owns compilation,
+checkpoints and sparse tables; serving composes them into four pieces:
+
+* :class:`~hetu_tpu.serving.session.InferenceSession` — frozen-graph
+  sessions over eval nodes + an ``Executor.save`` checkpoint dir, with
+  mandatory shape bucketing so ragged traffic cannot cause a retrace
+  storm (``jit_compiles`` is bounded by the bucket count).
+* :class:`~hetu_tpu.serving.batcher.MicroBatcher` — thread-safe dynamic
+  micro-batching: concurrent ``submit()`` calls coalesce into one padded
+  batch per tick (``max_batch_size`` / ``max_wait_ms``), results split
+  back per request, queue-depth / latency / occupancy metrics exported
+  through ``hetu_tpu/telemetry/metrics.py``.
+* :class:`~hetu_tpu.serving.decode.GPTDecoder` — KV-cache autoregressive
+  decode for the GPT family (prefill on the flash-attention path, O(S)
+  single-token steps, greedy + temperature sampling), numerically pinned
+  against the full-sequence graph forward.
+* :mod:`~hetu_tpu.serving.embedding` — PS-backed sparse serving: eval
+  graphs rewritten to pull embedding rows from the parameter server
+  read-only (a push from a serving session raises), with a host row
+  cache and hit-rate gauge.
+* :class:`~hetu_tpu.serving.http.ServingHTTPServer` — minimal stdlib
+  JSON frontend over a session or batcher (``/v1/predict``, ``/healthz``,
+  ``/metrics``).
+"""
+from .session import InferenceSession, next_bucket
+from .batcher import MicroBatcher
+from .decode import GPTDecoder
+from .embedding import ReadOnlyPSClient, serve_embeddings_from_ps
+from .http import ServingHTTPServer
+
+__all__ = ["InferenceSession", "MicroBatcher", "GPTDecoder",
+           "ReadOnlyPSClient", "serve_embeddings_from_ps",
+           "ServingHTTPServer", "next_bucket"]

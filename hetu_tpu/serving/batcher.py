@@ -1,0 +1,191 @@
+"""Dynamic micro-batching (Orca/TF-Serving shape, host-side tick loop).
+
+Concurrent ``submit()`` calls coalesce into ONE forward per tick: the
+batcher thread claims up to ``max_batch_size`` rows, waiting at most
+``max_wait_ms`` for stragglers after the first request arrives, then
+concatenates the feeds along the batch dim, runs ``serve_fn`` once, and
+splits the outputs back per request. Requests stay whole — a request's
+rows never split across ticks.
+
+Telemetry (through ``hetu_tpu/telemetry/metrics.py``): ``<name>_queue_depth``
+gauge, ``<name>_latency_ms`` p50/p95/p99 histogram (submit -> result),
+``<name>_batch_size`` / ``<name>_batch_occupancy`` histograms, and
+``<name>_requests`` / ``<name>_batches`` counters.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+
+__all__ = ["MicroBatcher"]
+
+
+class _Request:
+    __slots__ = ("feeds", "n", "future", "t_submit")
+
+    def __init__(self, feeds, n, future):
+        self.feeds = feeds
+        self.n = n
+        self.future = future
+        self.t_submit = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into one forward per tick.
+
+    ``serve_fn(feeds)`` takes ``{key: np.ndarray}`` with a shared leading
+    batch dim and returns an array, or a list/tuple of arrays, each with
+    that same leading dim (an ``InferenceSession.predict`` bound method
+    fits directly; so does a jitted decode step)."""
+
+    def __init__(self, serve_fn, *, max_batch_size=32, max_wait_ms=2.0,
+                 telemetry=None, name="serve"):
+        self.serve_fn = serve_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self.telemetry = _telemetry.resolve(telemetry)
+        self.name = name
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"{name}-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, feeds):
+        """Enqueue one request (each value ``[n, ...]``); returns a
+        Future resolving to ``serve_fn``'s output sliced to this
+        request's rows."""
+        arrays = {k: np.asarray(v) for k, v in feeds.items()}
+        sizes = {v.shape[0] for v in arrays.values() if v.ndim}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"request feeds disagree on batch size: {sorted(sizes)}")
+        n = sizes.pop()
+        if n > self.max_batch_size:
+            raise ValueError(
+                f"request of {n} rows exceeds max_batch_size "
+                f"{self.max_batch_size}; split it client-side")
+        req = _Request(arrays, n, Future())
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append(req)
+            self._set_depth()
+            self._cond.notify()
+        return req.future
+
+    def _set_depth(self):
+        if self.telemetry.enabled:
+            self.telemetry.set_gauge(f"{self.name}_queue_depth",
+                                     len(self._queue))
+
+    # ------------------------------------------------------------------
+    def _take_tick(self):
+        """Block for the next tick's requests (None = closed + drained)."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            # the wait budget runs from the FIRST request's submit, not
+            # from when this thread got around to looking — a request
+            # that already queued behind a slow tick must not wait the
+            # full max_wait again
+            deadline = self._queue[0].t_submit + self.max_wait
+            batch, total = [], 0
+            keys = frozenset(self._queue[0].feeds)
+            while True:
+                while self._queue and \
+                        frozenset(self._queue[0].feeds) == keys and \
+                        (not batch
+                         or total + self._queue[0].n
+                         <= self.max_batch_size):
+                    req = self._queue.popleft()
+                    batch.append(req)
+                    total += req.n
+                if total >= self.max_batch_size or self._closed:
+                    break
+                if self._queue:
+                    # head doesn't fit, or carries a DIFFERENT feed-key
+                    # set (coalescing it would drop its extra keys):
+                    # it starts the next tick
+                    break
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            self._set_depth()
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_tick()
+            if batch is None:
+                return
+            self._serve(batch)
+
+    def _serve(self, batch):
+        # the WHOLE tick is guarded: a malformed request (ragged trailing
+        # dims, mismatched feed keys) must fail that tick's futures, not
+        # kill the batcher thread and strand every later submit
+        try:
+            keys = list(batch[0].feeds)
+            feeds = {k: (np.concatenate([r.feeds[k] for r in batch])
+                         if len(batch) > 1 else batch[0].feeds[k])
+                     for k in keys}
+            outs = self.serve_fn(feeds)
+        except Exception as e:                          # noqa: BLE001
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        single = not isinstance(outs, (list, tuple))
+        outs = [outs] if single else list(outs)
+        total = sum(r.n for r in batch)
+        off = 0
+        now = time.perf_counter()
+        tel = self.telemetry
+        try:
+            for r in batch:
+                sl = [o[off:off + r.n]
+                      if getattr(o, "ndim", 0) and o.shape[0] >= total
+                      else o for o in outs]
+                r.future.set_result(sl[0] if single else sl)
+                off += r.n
+                if tel.enabled:
+                    tel.observe(f"{self.name}_latency_ms",
+                                (now - r.t_submit) * 1e3)
+        except Exception as e:                          # noqa: BLE001
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        if tel.enabled:
+            tel.inc(f"{self.name}_requests", len(batch))
+            tel.inc(f"{self.name}_batches")
+            tel.observe(f"{self.name}_batch_size", total)
+            tel.observe(f"{self.name}_batch_occupancy",
+                        total / self.max_batch_size)
+
+    # ------------------------------------------------------------------
+    def close(self):
+        """Stop accepting requests, serve what's queued, join the
+        thread."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,163 @@
+"""KV-cache autoregressive decode for the GPT family.
+
+``GPTDecoder`` lifts a trained ``GPTLMHeadModel`` checkpoint (or a live
+``InferenceSession``) into a weight-level decode loop:
+
+* **prefill**: one full causal forward over the prompt (the flash-
+  attention path on TPU) that also writes the prompt's K/V rows into
+  preallocated ``[B, H, S_max, D]`` buffers,
+* **decode**: a jit-compiled cached single-token forward
+  (``models/gpt.py:gpt_cached_step``) — write-index into the K/V
+  buffers, position-indexed learned embeddings, no ``[S, S]`` mask —
+  with the cache donated so the update happens in place in HBM,
+* **generate**: greedy or temperature sampling, numerically pinned
+  against the full-sequence graph forward (tests/test_serving.py).
+
+Compile accounting: the decode step's jit cache keys only on batch size
+(position is a traced scalar). Prefill keys on (batch, prompt length) —
+so ``generate()`` buckets ragged prompt lengths (``prompt_buckets``,
+power-of-two ladder by default) the same way ``InferenceSession``
+buckets batch: a serving loop compiles once per (batch, prompt-bucket)
+pair plus once per batch for the step, and never again. Bare
+``prefill()`` calls are exact-shape by design (callers needing the
+per-position prompt logits get exactly their length back).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry as _telemetry
+from ..models.gpt import (gpt_cached_step, gpt_prefill, gpt_serving_params,
+                          init_kv_cache)
+from .session import next_bucket
+
+__all__ = ["GPTDecoder"]
+
+
+class GPTDecoder:
+    def __init__(self, config, lookup, max_len=None, prompt_buckets=None,
+                 telemetry=None):
+        """``lookup(name) -> array`` resolves checkpoint parameter names
+        (see ``models/gpt.py:gpt_param_names``); use the classmethods for
+        the common sources. ``prompt_buckets`` bounds generate()'s
+        prefill compiles under ragged prompt lengths (None = powers of
+        two)."""
+        self.config = config
+        self.prompt_buckets = (tuple(sorted(prompt_buckets))
+                               if prompt_buckets else None)
+        self.max_len = int(max_len or config.max_position_embeddings)
+        if self.max_len > config.max_position_embeddings:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's learned "
+                f"positions ({config.max_position_embeddings})")
+        self.telemetry = _telemetry.resolve(telemetry)
+        self.params = gpt_serving_params(config, lookup)
+        nh = config.num_attention_heads
+        act = getattr(config, "hidden_act", "gelu")
+        # donate the kv argument: the cache buffers update in place
+        self._prefill = jax.jit(
+            functools.partial(gpt_prefill, num_heads=nh, hidden_act=act),
+            donate_argnums=(1,))
+        self._step = jax.jit(
+            functools.partial(gpt_cached_step, num_heads=nh,
+                              hidden_act=act),
+            donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_session(cls, session, config, **kw):
+        """From a live :class:`InferenceSession` over the same model
+        (shares the session's device-resident parameters)."""
+        params = session.params_by_name()
+        return cls(config, params.__getitem__, **kw)
+
+    @classmethod
+    def from_checkpoint(cls, config, path, **kw):
+        """From an ``Executor.save`` checkpoint directory (frozen:
+        reads only the per-parameter ``.npy`` files)."""
+        def lookup(name):
+            f = os.path.join(path, name + ".npy")
+            if not os.path.exists(f):
+                raise FileNotFoundError(
+                    f"checkpoint {path} has no parameter {name!r} "
+                    f"(expected {f})")
+            return np.load(f)
+        return cls(config, lookup, **kw)
+
+    # ------------------------------------------------------------------
+    def prefill(self, ids):
+        """Prompt phase over ``ids [B, P]``: returns
+        ``(logits [B, P, V], kv)`` with K/V rows ``0..P-1`` written."""
+        ids = jnp.asarray(ids, jnp.int32)
+        kv = init_kv_cache(self.config, ids.shape[0], self.max_len)
+        logits, kv = self._prefill(self.params, kv, ids)
+        if self.telemetry.enabled:
+            self.telemetry.inc("decode_prefill_tokens", int(np.prod(
+                ids.shape)))
+        return logits, kv
+
+    def decode_step(self, kv, tokens, pos):
+        """One cached step: ``tokens [B]`` at position ``pos``. Returns
+        ``(logits [B, V], kv)``. The passed ``kv`` is consumed
+        (donated)."""
+        return self._step(self.params, kv, jnp.asarray(tokens, jnp.int32),
+                          jnp.int32(pos))
+
+    # ------------------------------------------------------------------
+    def generate(self, prompts, max_new_tokens, temperature=0.0, seed=0,
+                 return_prompt=False):
+        """Autoregressive continuation of ``prompts [B, P]``.
+
+        ``temperature=0`` is greedy argmax; otherwise tokens sample from
+        ``softmax(logits / temperature)``. Returns ``[B, T]`` numpy
+        (``[B, P+T]`` with ``return_prompt=True``)."""
+        prompts = np.asarray(prompts)
+        b, p = prompts.shape
+        if p < 1:
+            raise ValueError("generate() needs at least one prompt token")
+        if max_new_tokens < 1:
+            return prompts.copy() if return_prompt else \
+                np.empty((b, 0), np.int32)
+        if p + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {p} + {max_new_tokens} new tokens exceeds the "
+                f"decoder's max_len {self.max_len}")
+        key = jax.random.PRNGKey(seed)
+        out = []            # device arrays; ONE host transfer at the end
+        # prompt-length bucketing: prefill compiles once per (batch,
+        # bucket), not once per exact length. The padded tail writes
+        # junk K/V rows at positions >= p, but decode overwrites row j
+        # at pos=j BEFORE the first step whose validity mask
+        # (arange <= pos) admits it — generation from pos=p proceeds
+        # sequentially, so no padded row is ever attended
+        pb = min(next_bucket(p, self.prompt_buckets), self.max_len)
+        if pb > p:
+            pad = np.repeat(prompts[:, -1:], pb - p, axis=1)
+            logits, kv = self.prefill(
+                np.concatenate([prompts, pad], axis=1))
+        else:
+            logits, kv = self.prefill(prompts)
+        last = logits[:, p - 1]
+        for t in range(max_new_tokens):
+            if temperature and temperature > 0.0:
+                tok = jax.random.categorical(
+                    jax.random.fold_in(key, t), last / temperature,
+                    axis=-1)
+            else:
+                tok = jnp.argmax(last, axis=-1)
+            tok = tok.astype(jnp.int32)
+            out.append(tok)     # stays on device: no per-token sync
+            if t + 1 < max_new_tokens:
+                last, kv = self.decode_step(kv, tok, p + t)
+        if self.telemetry.enabled:
+            self.telemetry.inc("decode_tokens", b * max_new_tokens)
+        gen = np.asarray(jnp.stack(out, axis=1))
+        if return_prompt:
+            return np.concatenate([prompts, gen], axis=1)
+        return gen

@@ -1,0 +1,139 @@
+"""Minimal stdlib HTTP frontend for a session or micro-batcher.
+
+JSON in / JSON out, three routes:
+
+* ``POST /v1/predict`` — body ``{"inputs": {feed_name: nested_list}}``;
+  each input carries its batch dim. Response
+  ``{"outputs": [...], "latency_ms": ...}``.
+* ``GET /healthz`` — liveness.
+* ``GET /metrics`` — Prometheus text scrape of the serving telemetry
+  (404 when telemetry is disabled).
+
+The backend is either an :class:`InferenceSession` (each request runs
+its own forward) or a :class:`MicroBatcher` (concurrent requests
+coalesce — the configuration the load driver in ``bench.py serving``
+measures). A production frontend would speak gRPC and shed load; this is
+deliberately the smallest thing that lets a multi-threaded closed-loop
+client exercise the batching + bucketing stack end to end.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+
+__all__ = ["ServingHTTPServer"]
+
+
+class ServingHTTPServer:
+    def __init__(self, backend, host="127.0.0.1", port=0, telemetry=None,
+                 request_timeout_s=60.0):
+        self.backend = backend
+        self.telemetry = _telemetry.resolve(telemetry)
+        self.host = host
+        self.port = int(port)
+        self.request_timeout_s = float(request_timeout_s)
+        self._httpd = None
+        self._thread = None
+        # the session backend is NOT thread-safe (shape inference writes
+        # on shared graph nodes); ThreadingHTTPServer handlers must
+        # single-flight it. The batcher backend serializes internally.
+        self._backend_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _predict(self, inputs):
+        feeds = {str(k): np.asarray(v) for k, v in inputs.items()}
+        backend = self.backend
+        if hasattr(backend, "submit"):          # MicroBatcher
+            outs = backend.submit(feeds).result(self.request_timeout_s)
+        else:                                   # InferenceSession
+            with self._backend_lock:
+                outs = backend.predict(feeds)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return [np.asarray(o).tolist() for o in outs]
+
+    # ------------------------------------------------------------------
+    def start(self):
+        """Bind + serve on a daemon thread; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code, body, ctype="application/json"):
+                data = body if isinstance(body, bytes) \
+                    else json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):                           # noqa: N802
+                path = self.path.rstrip("/")
+                if path == "/healthz":
+                    self._reply(200, {"ok": True})
+                elif path == "/metrics":
+                    tel = server.telemetry
+                    if not tel.enabled:
+                        self.send_error(404, "telemetry disabled")
+                        return
+                    self._reply(200, tel.metrics.to_prometheus().encode(),
+                                ctype="text/plain; version=0.0.4")
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):                          # noqa: N802
+                if self.path.rstrip("/") != "/v1/predict":
+                    self.send_error(404)
+                    return
+                t0 = time.perf_counter()
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    inputs = req.get("inputs", {})
+                    if not isinstance(inputs, dict):
+                        raise ValueError(
+                            '"inputs" must be an object of '
+                            "{feed_name: nested_list}")
+                    outs = server._predict(inputs)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                except Exception as e:                  # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                ms = (time.perf_counter() - t0) * 1e3
+                if server.telemetry.enabled:
+                    server.telemetry.observe("http_request_ms", ms)
+                self._reply(200, {"outputs": outs,
+                                  "latency_ms": round(ms, 3)})
+
+            def log_message(self, *a):                  # quiet
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="serving-http")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
