@@ -33,6 +33,8 @@ import jax.numpy as jnp
 
 from . import ndarray
 from . import telemetry as _telemetry
+from .telemetry import memory as _memory
+from .telemetry import watchdog as _watchdog
 from .context import (DeviceGroup, get_current_context,
                       get_launch_config_by_traverse_nodes)
 from .graph.autodiff import (find_topo_sort, gradients, sum_node_list,
@@ -526,12 +528,33 @@ class SubExecutor:
 
         return step_fn
 
-    def _compile_step(self):
+    def _compile_step(self, args=None):
         # donate params, op state and optimizer slots: the update is
         # in-place in HBM (state matters for the device-cache acc, which
         # is table-sized)
         donate = (0, 1, 2) if self.training else ()
-        return jax.jit(self._build_step(), donate_argnums=donate)
+        return self._aot_compile(
+            jax.jit(self._build_step(), donate_argnums=donate), args)
+
+    def _aot_compile(self, jitted, args):
+        """With telemetry on and concrete ``args``, lower+compile ahead
+        of time so (a) the XLA compile cost lands inside the
+        ``jit_compile`` span instead of hiding in the first
+        ``device_dispatch`` and (b) ``compiled.memory_analysis()`` —
+        argument/output/temp/generated-code bytes — is capturable for
+        the memory gauge family. Falls back to the implicit-jit path
+        (compile at first call, exactly the pre-existing behavior) when
+        telemetry is off or lowering rejects an input kind."""
+        self._last_mem = None
+        if args is None or not self.config.telemetry.enabled:
+            return jitted
+        try:
+            compiled = jitted.lower(*args).compile()
+        except Exception:       # noqa: BLE001 — lazily compile instead
+            return jitted
+        self._last_mem = _memory.capture_compile(
+            self.config.telemetry, compiled, label=self.name)
+        return compiled
 
     @contextlib.contextmanager
     def _compile_span(self, key):
@@ -546,8 +569,11 @@ class SubExecutor:
         t0 = tel.clock()
         yield
         t1 = tel.clock()
-        tel.complete("jit_compile", t0, t1,
-                     {"subgraph": self.name, "shape_key": str(key)})
+        args = {"subgraph": self.name, "shape_key": str(key)}
+        if getattr(self, "_last_mem", None):
+            # memory_analysis numbers ride the jit_compile span
+            args.update(self._last_mem)
+        tel.complete("jit_compile", t0, t1, args)
         tel.inc("jit_compiles")
         tel.observe("jit_compile_ms", (t1 - t0) / 1e6)
 
@@ -606,13 +632,6 @@ class SubExecutor:
                         convert):
         """Compile-or-reuse the nsteps scan block and dispatch it (shared
         by the host-feed path above and the PS runtime's block path)."""
-        key = ("block", nsteps) + self._shape_key(first_map)
-        if key not in self.compiled:
-            with self._compile_span(key):
-                self._infer_shapes(first_map)
-                self._ensure_state(executor)
-                self.compiled[key] = self._build_block(nsteps)
-        fn = self.compiled[key]
         feeds = [feed_map[n] for n in self._feed_order()]
         # per-step learning rates: the scheduler advances exactly as it
         # would across nsteps sequential run() calls
@@ -623,6 +642,17 @@ class SubExecutor:
                 lrs[k] = np.float32(sched.get())
                 if self.training:
                     sched.step()
+        key = ("block", nsteps) + self._shape_key(first_map)
+        if key not in self.compiled:
+            with self._compile_span(key):
+                self._infer_shapes(first_map)
+                self._ensure_state(executor)
+                self.compiled[key] = self._aot_compile(
+                    self._build_block(nsteps),
+                    (executor.params, executor.state, executor.opt_state,
+                     feeds, lrs, np.int32(self.step_count),
+                     executor.base_rng))
+        fn = self.compiled[key]
         with self.config.telemetry.span("block_dispatch"):
             outs, new_params, new_state, new_opt = fn(
                 executor.params, executor.state, executor.opt_state,
@@ -726,7 +756,8 @@ class SubExecutor:
             with self._compile_span(key):
                 self._infer_shapes(feed_map)
                 self._ensure_state(executor)
-                self.compiled[key] = self._compile_step()
+                self.compiled[key] = self._compile_step(
+                    self.trace_args(executor, feed_map))
         fn = self.compiled[key]
 
         with self.config.telemetry.span("device_dispatch"):
@@ -909,6 +940,12 @@ class Executor:
             self.step_logger = StepLogger(config.log_path,
                                           telemetry=config.telemetry)
 
+        # -- fleet watchdog heartbeat (telemetry/watchdog.py) ----------
+        # armed by `heturun --hang-timeout` (HETU_WATCHDOG_DIR); None
+        # otherwise, so the per-step cost of the disabled path is one
+        # `is None` check
+        self._heartbeat = _watchdog.heartbeat_from_env()
+
     @property
     def base_rng(self):
         return self._base_rng
@@ -927,20 +964,46 @@ class Executor:
             name = "default"
         if self.step_logger is not None:
             self.step_logger.begin()
+        sub = self.subexecutors[name]
         tel = self.config.telemetry
-        if tel.enabled:
-            t0 = time.perf_counter()
-            with tel.span("step", subgraph=name):
-                out = self.subexecutors[name].run(
-                    self, feed_dict, convert_to_numpy_ret_vals)
-            tel.observe("step_wall_ms",
-                        (time.perf_counter() - t0) * 1000.0)
-        else:
-            out = self.subexecutors[name].run(
-                self, feed_dict, convert_to_numpy_ret_vals)
+        try:
+            if tel.enabled:
+                t0 = time.perf_counter()
+                with tel.span("step", subgraph=name):
+                    out = sub.run(self, feed_dict,
+                                  convert_to_numpy_ret_vals)
+                tel.observe("step_wall_ms",
+                            (time.perf_counter() - t0) * 1000.0)
+                # black box: step boundary into the flight ring +
+                # live/peak device bytes (no-op on backends that don't
+                # report — memory.py caches the probe)
+                tel.flight_step(sub.step_count)
+                _memory.observe_device_memory(tel)
+            else:
+                out = sub.run(self, feed_dict, convert_to_numpy_ret_vals)
+        except Exception as e:
+            if _memory.is_oom(e):
+                self._report_oom(e)
+            raise
+        if self._heartbeat is not None:
+            self._heartbeat.beat(sub.step_count)
         if self.step_logger is not None:
             self.step_logger.end(self, subgraph=name)
         return out
+
+    def _report_oom(self, exc):
+        """RESOURCE_EXHAUSTED post-mortem: print (and write into the
+        telemetry dir) the largest live buffers before re-raising, so
+        the OOM names tensors instead of just a byte count."""
+        import sys
+        named = {node.name: self.params[sid]
+                 for sid, node in self._param_nodes.items()
+                 if sid in self.params}
+        text = _memory.oom_report(
+            named_params=named,
+            out_dir=self.config.telemetry.out_dir,
+            rank=self.config.telemetry.rank)
+        print(text, file=sys.stderr)
 
     def run_batches(self, feed_dicts, name="default",
                     convert_to_numpy_ret_vals=False):
@@ -959,10 +1022,23 @@ class Executor:
                 "dispatch over microbatches; call run() per step")
         needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
                     or sub.cached_lookups)
-        if needs_ps:
-            return self.ps_runtime.run_block(
-                sub, feed_dicts, convert_to_numpy_ret_vals)
-        return sub.run_block(self, feed_dicts, convert_to_numpy_ret_vals)
+        try:
+            if needs_ps:
+                out = self.ps_runtime.run_block(
+                    sub, feed_dicts, convert_to_numpy_ret_vals)
+            else:
+                out = sub.run_block(self, feed_dicts,
+                                    convert_to_numpy_ret_vals)
+        except Exception as e:
+            if _memory.is_oom(e):
+                self._report_oom(e)
+            raise
+        tel = self.config.telemetry
+        if tel.enabled:
+            tel.flight_step(sub.step_count)
+        if self._heartbeat is not None:
+            self._heartbeat.beat(sub.step_count)
+        return out
 
     def run_batches_stream(self, blocks, name="default",
                            convert_to_numpy_ret_vals=False):
@@ -1110,6 +1186,9 @@ class Executor:
         if self.step_logger is not None:
             self.step_logger.close()
             self.step_logger = None
+        if self._heartbeat is not None:
+            # clean completion: the watchdog stops counting this rank
+            self._heartbeat.done()
         self.config.telemetry.flush()
 
     def __del__(self):

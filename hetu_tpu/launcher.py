@@ -219,7 +219,8 @@ def _worker_env(cfg, base_env, rank, coordinator=None):
     return env
 
 
-def launch_command(cfg, command, identify=None, telemetry=None):
+def launch_command(cfg, command, identify=None, telemetry=None,
+                   hang_timeout=None):
     """Run ``command`` once per worker with the cluster env wired
     (the ``heturun -c conf.yml python train.py`` path).
 
@@ -228,23 +229,53 @@ def launch_command(cfg, command, identify=None, telemetry=None):
     trace + metrics files there (HETU_TELEMETRY), each PS server serves
     a Prometheus ``/metrics`` scrape (HETU_TELEMETRY_PORT), and after
     the workers exit the launcher merges the per-rank traces into ONE
-    Perfetto-loadable ``trace_merged.json``."""
+    Perfetto-loadable ``trace_merged.json``.
+
+    ``hang_timeout`` (seconds, from ``--hang-timeout``) arms the fleet
+    watchdog: workers heartbeat per step into the telemetry dir
+    (HETU_WATCHDOG_DIR); when any rank stalls past the timeout the
+    launcher collects faulthandler stack dumps + flight-record dumps
+    from every live rank, kills the fleet, and exits with the distinct
+    watchdog code (telemetry/watchdog.py) — a hung pipeline becomes a
+    diagnosed failure instead of an eternal CI timeout. The watchdog
+    implies telemetry (a temp dir is created when ``--telemetry`` was
+    not given)."""
     endpoints = cfg.server_endpoints()
     server_env = {}
     tdir = None
+    if hang_timeout and not telemetry:
+        import tempfile
+        telemetry = tempfile.mkdtemp(prefix="hetu-watchdog-")
+        print(f"watchdog: --hang-timeout without --telemetry; black-box "
+              f"dumps go to {telemetry}")
     if telemetry:
         tdir = os.path.abspath(telemetry)
         os.makedirs(tdir, exist_ok=True)
+        _clear_stale_blackbox(tdir)
         scrape_base = int(os.environ.get("HETU_TELEMETRY_BASE_PORT",
                                          "18790"))
         for i, (host, _) in enumerate(endpoints):
-            server_env[i] = {"HETU_TELEMETRY_PORT": str(scrape_base + i)}
+            server_env[i] = {"HETU_TELEMETRY_PORT": str(scrape_base + i),
+                             # server faulthandler stacks land in the
+                             # same dir the workers dump into
+                             "HETU_TELEMETRY": tdir}
             print(f"telemetry: PS server {i} scrape at "
                   f"http://{host}:{scrape_base + i}/metrics")
     _spawn_servers(cfg, endpoints, identify, extra_env=server_env)
     ps_env = _ps_env(cfg, endpoints)
     if tdir:
         ps_env["HETU_TELEMETRY"] = tdir
+    if hang_timeout:
+        ps_env["HETU_WATCHDOG_DIR"] = tdir
+        ps_env["HETU_HANG_TIMEOUT"] = str(float(hang_timeout))
+        if not cfg.single_host:
+            # remote ranks heartbeat/dump on THEIR filesystem and the
+            # diagnose signals hit the local ssh client, which does not
+            # forward them — same scope caveat as the trace merge
+            print("watchdog: WARNING multi-host fleet — stall detection "
+                  "and stack/flight dumps cover launcher-local ranks "
+                  "only; remote ranks are torn down via their ssh "
+                  "clients without dumps")
     coordinator = None
     if not cfg.single_host or cfg.spmd:
         # deterministic port: probing the launcher machine says nothing
@@ -292,14 +323,61 @@ def launch_command(cfg, command, identify=None, telemetry=None):
             _procs.append(p)
             rank += 1
 
-    rc = 0
-    for p in workers:
-        p.wait()
-        rc = rc or p.returncode
+    if hang_timeout:
+        rc = _wait_with_watchdog(workers, tdir, float(hang_timeout))
+    else:
+        rc = 0
+        for p in workers:
+            p.wait()
+            rc = rc or p.returncode
     _shutdown()
     if tdir:
         _merge_telemetry(tdir, cfg.num_workers)
     return rc
+
+
+def _wait_with_watchdog(workers, tdir, hang_timeout):
+    """Poll the fleet under the watchdog: normal completion returns the
+    usual first-nonzero rc; a stalled rank triggers the diagnose-then-
+    kill sequence and the distinct watchdog exit code."""
+    from .telemetry.watchdog import FleetWatchdog
+    wd = FleetWatchdog(tdir, num_workers=len(workers),
+                       timeout=hang_timeout)
+    by_rank = dict(enumerate(workers))
+    while any(p.poll() is None for p in workers):
+        stalled = wd.check(by_rank)
+        if stalled:
+            for rank, age, step in stalled:
+                print(f"watchdog: rank {rank} stalled "
+                      f"{age:.1f}s > {hang_timeout:.1f}s "
+                      f"(last step {step}) — collecting stack + "
+                      f"flight dumps, killing fleet")
+            rc = wd.fire(by_rank)
+            print(f"watchdog: fleet killed; post-mortem with "
+                  f"`python -m hetu_tpu.telemetry.blackbox {tdir}` "
+                  f"(exit code {rc})")
+            return rc
+        time.sleep(min(0.25, hang_timeout / 8))
+    rc = 0
+    for p in workers:
+        rc = rc or p.returncode
+    return rc
+
+
+def _clear_stale_blackbox(tdir):
+    """Drop a previous fleet's heartbeats / flight dumps / stack logs
+    from a reused --telemetry dir. A stale hb_rank*.json with an old
+    timestamp would false-fire the watchdog on the brand-new healthy
+    fleet within its first poll, and stale flight dumps would pollute
+    the new run's blackbox report."""
+    import glob as _glob
+    for pat in ("hb_rank*.json", "flight_rank*.json", "stacks_*.log",
+                "oom_rank*.txt"):
+        for path in _glob.glob(os.path.join(tdir, pat)):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
 
 def _merge_telemetry(tdir, num_workers=None):
@@ -388,6 +466,14 @@ def main(argv=None):
                              "under DIR, merged into one Perfetto "
                              "trace at exit; PS servers serve "
                              "Prometheus /metrics")
+    parser.add_argument("--hang-timeout", type=float, default=None,
+                        metavar="SECONDS",
+                        help="arm the fleet watchdog: when any rank's "
+                             "heartbeat stalls past SECONDS, dump "
+                             "stacks + flight records on every rank "
+                             "and kill the fleet with a distinct exit "
+                             "code (set it above worst-case compile "
+                             "time)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -398,7 +484,8 @@ def main(argv=None):
           f"workers({cfg.num_workers})={cfg.workers}")
     signal.signal(signal.SIGINT, _shutdown)
     return launch_command(cfg, args.command, args.identify,
-                          telemetry=args.telemetry)
+                          telemetry=args.telemetry,
+                          hang_timeout=args.hang_timeout)
 
 
 if __name__ == "__main__":

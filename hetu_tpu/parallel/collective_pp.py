@@ -381,12 +381,22 @@ class CollectiveGPipe:
                 feeds = self._replicate(feeds_all)
         S, M = self.S, self.M
         fill = S - 1 if self.unroll_fill_drain else 0
+        # black box: the schedule is one SPMD program dispatched by
+        # every rank in lockstep — a "collective"-group flight entry per
+        # dispatch gives the blackbox CLI an aligned seq stream, so the
+        # rank that stops dispatching (or dispatches one more than the
+        # rest) is nameable by its first seq divergence
+        frec = tel.flight_start("collective", "cpp_dispatch",
+                                tag=f"step{int(step)}",
+                                nbytes=self.S * self._row_bytes)
         with tel.span("cpp_dispatch", ticks=M + S - 1, fill=fill,
                       drain=fill, fuse_ticks=self.fuse_ticks,
                       stages=S, microbatches=M):
-            return self._step(tuple(stacked_params), tuple(opt_state),
-                              feeds, base_rng, jnp.int32(step),
-                              jnp.float32(lr))
+            out = self._step(tuple(stacked_params), tuple(opt_state),
+                             feeds, base_rng, jnp.int32(step),
+                             jnp.float32(lr))
+        tel.flight_complete(frec)
+        return out
 
     # -- placement helpers ----------------------------------------------
     def place_stacked(self, arrs_by_stage):

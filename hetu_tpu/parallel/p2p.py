@@ -148,14 +148,28 @@ class PipeChannel:
         tel = _telemetry.get_telemetry()
         if not tel.enabled:
             return self._recv(tag, timeout)
+        # black box: a recv that never completes is the signature of a
+        # dead/diverged peer — the pending flight entry names the tag
+        # (and the blackbox CLI names the rank it implies)
+        frec = tel.flight_start("p2p", "p2p_recv",
+                                peer=self._peer_of_tag(tag), tag=tag)
         t0 = tel.clock()
         arr = self._recv(tag, timeout)
         t1 = tel.clock()
+        tel.flight_complete(frec)
         tel.complete("p2p_recv", t0, t1,
                      {"tag": tag, "bytes": int(arr.nbytes)})
         tel.inc("p2p_recv_bytes", int(arr.nbytes))
         tel.observe("p2p_recv_wait_ms", (t1 - t0) / 1e6)
         return arr
+
+    def _peer_of_tag(self, tag):
+        """Best-effort peer rank for a recv: in a 2-process fleet the
+        sender is unambiguous; beyond that the tag itself is the
+        diagnostic and the peer stays unknown (None)."""
+        if self.nprocs == 2:
+            return 1 - self.rank
+        return None
 
     def _recv(self, tag, timeout=None):
         if timeout is None:
@@ -207,8 +221,11 @@ class PipeChannel:
         if not tel.enabled:
             return self._send(dst, tag, arr)
         nbytes = int(getattr(arr, "nbytes", 0))
+        frec = tel.flight_start("p2p", "p2p_send", peer=dst, tag=tag,
+                                nbytes=nbytes)
         with tel.span("p2p_send", tag=tag, dst=dst, bytes=nbytes):
             self._send(dst, tag, arr)
+        tel.flight_complete(frec)
         tel.inc("p2p_send_bytes", nbytes)
 
     def _send(self, dst, tag, arr):

@@ -58,6 +58,26 @@ __all__ = ["PipelineSubExecutor"]
 _NULL_CM = _telemetry.NULL.span("")     # shared no-op context manager
 
 
+class _FlightSpan:
+    """Span context manager that also completes a flight-ring record on
+    exit — one object so stage-block call sites stay a single `with`."""
+
+    __slots__ = ("_tel", "_span", "_rec")
+
+    def __init__(self, tel, span, rec):
+        self._tel = tel
+        self._span = span
+        self._rec = rec
+
+    def __enter__(self):
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._tel.flight_complete(self._rec)
+        return self._span.__exit__(*exc)
+
+
 class _Stage:
     __slots__ = ("index", "device", "devices", "mesh", "node_spec",
                  "nodes", "param_nodes", "feed_nodes",
@@ -781,11 +801,16 @@ class PipelineSubExecutor:
 
     def _stage_span(self, name, stage_index):
         """Span for one stage-level dispatch (no-op when telemetry is
-        off — the kwargs dict only builds on the enabled path)."""
+        off — the kwargs dict only builds on the enabled path). The
+        enabled path also feeds the flight ring (group ``sched``): a
+        fleet that hangs mid-schedule leaves "how far each rank's
+        schedule got" in the black box even though the span never
+        exports."""
         tel = self.config.telemetry
         if not tel.enabled:
             return _NULL_CM
-        return tel.span(name, stage=stage_index)
+        rec = tel.flight_start("sched", name, tag=f"stage{stage_index}")
+        return _FlightSpan(tel, tel.span(name, stage=stage_index), rec)
 
     def _recv_traced(self, ch, tag, stage_index):
         """Blocking channel recv, recorded as that stage's idle (bubble)

@@ -12,9 +12,25 @@ import os
 
 import numpy as np
 
+from .. import telemetry as _telemetry
 from .native_lib import as_f32, as_i64, fptr, get_lib, lptr
 
 _default_client = None
+
+
+def _flight(kind, tid, nbytes):
+    """Black-box record of one PS RPC (flight.py): an RPC that never
+    returns — dead server, wedged van thread — is a pending entry
+    naming the tensor id and byte count. The disabled path returns
+    before the tag string is built — no per-RPC allocations with
+    telemetry off."""
+    tel = _telemetry.get_telemetry()
+    if not tel.enabled:
+        return None
+    return tel.flight.start("ps", kind, tag=f"tid{tid}", nbytes=nbytes)
+
+
+_flight_done = _telemetry.Telemetry.flight_complete
 
 # reference OptType mapping (ps/server/optimizer.h:15-22)
 OPT_KIND = {"SGD": 0, "Momentum": 1, "Nesterov": 2, "AdaGrad": 3,
@@ -62,13 +78,17 @@ class PSClient:
     # -- dense ----------------------------------------------------------
     def pull(self, tid, shape):
         out = np.empty(int(np.prod(shape)), np.float32)
+        rec = _flight("ps_pull", tid, out.nbytes)
         rc = self.lib.Pull(tid, fptr(out), out.size)
+        _flight_done(rec)
         assert rc == 0, f"Pull({tid}) failed: {rc}"
         return out.reshape(shape)
 
     def push(self, tid, grad):
         g = as_f32(grad).ravel()
+        rec = _flight("ps_push", tid, g.nbytes)
         self.lib.Push(tid, fptr(g), g.size)
+        _flight_done(rec)
 
     def dd_pushpull(self, tid, grad, out=None):
         g = as_f32(grad).ravel()
@@ -78,19 +98,25 @@ class PSClient:
         # must be the caller-visible contiguous memory, not a ravel() copy
         assert out.dtype == np.float32 and out.flags["C_CONTIGUOUS"], \
             "dd_pushpull needs a C-contiguous float32 output buffer"
+        rec = _flight("ps_dd_pushpull", tid, g.nbytes)
         self.lib.DDPushPull(tid, fptr(g), fptr(out), g.size)
+        _flight_done(rec)
         return out
 
     # -- sparse ---------------------------------------------------------
     def sparse_push(self, tid, indices, values, width):
         idx = as_i64(indices).ravel()
         vals = as_f32(values).reshape(idx.size, width)
+        rec = _flight("ps_sparse_push", tid, vals.nbytes)
         self.lib.SparsePush(tid, lptr(idx), fptr(vals), idx.size, width)
+        _flight_done(rec)
 
     def sparse_pull(self, tid, indices, width):
         idx = as_i64(indices).ravel()
         out = np.empty((idx.size, width), np.float32)
+        rec = _flight("ps_sparse_pull", tid, out.nbytes)
         rc = self.lib.SparsePull(tid, lptr(idx), fptr(out), idx.size, width)
+        _flight_done(rec)
         assert rc == 0, f"SparsePull({tid}) failed: {rc}"
         return out.reshape(tuple(np.shape(indices)) + (width,))
 
@@ -119,8 +145,10 @@ class PSClient:
         Returns refreshed-row count (cache miss-rate numerator)."""
         idx = as_i64(indices).ravel()
         ver = as_i64(versions).ravel()
+        rec = _flight("ps_sync_embedding", tid, idx.size * 4 * width)
         n = self.lib.SyncEmbedding(tid, int(bound), lptr(idx), lptr(ver),
                                    idx.size, fptr(out_rows), width)
+        _flight_done(rec)
         versions[...] = ver.reshape(np.shape(versions))
         return n
 
@@ -128,18 +156,28 @@ class PSClient:
         idx = as_i64(indices).ravel()
         vals = as_f32(values).reshape(idx.size, width)
         upd = as_i64(updates).ravel()
+        rec = _flight("ps_push_embedding", tid, vals.nbytes)
         self.lib.PushEmbedding(tid, lptr(idx), fptr(vals), lptr(upd),
                                idx.size, width)
+        _flight_done(rec)
 
     # -- control --------------------------------------------------------
     def wait(self, tid):
+        rec = _flight("ps_wait", tid, 0)
         self.lib.Wait(tid)
+        _flight_done(rec)
 
     def wait_all(self):
+        rec = _flight("ps_wait_all", -1, 0)
         self.lib.WaitAll()
+        _flight_done(rec)
 
     def barrier(self):
+        # the BSP barrier is the canonical distributed hang site: a
+        # worker that died mid-step leaves everyone else pending here
+        rec = _flight("ps_barrier", -1, 0)
         self.lib.BarrierWorker()
+        _flight_done(rec)
 
     def clear(self, tid):
         return self.lib.Clear(tid)

@@ -39,9 +39,29 @@ def _serve_metrics(ps_port, nworkers):
     return reg
 
 
+def _register_faulthandler(port):
+    """SIGUSR1 -> all-thread stack dump, so a wedged server process is
+    inspectable live (and the fleet watchdog's diagnose-then-kill
+    sequence collects server stacks too). Dumps land in the telemetry
+    dir when the launcher exported one, stderr otherwise."""
+    import faulthandler
+    import signal
+    try:
+        tdir = os.environ.get("HETU_TELEMETRY")
+        if tdir:
+            os.makedirs(tdir, exist_ok=True)
+            f = open(os.path.join(tdir, f"stacks_server{port}.log"), "a")
+        else:
+            f = sys.stderr
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+    except (ValueError, OSError, AttributeError):
+        pass
+
+
 def main():
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 18590
     nworkers = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    _register_faulthandler(port)
     try:
         _serve_metrics(port, nworkers)
     except OSError as e:

@@ -114,10 +114,16 @@ class PSRuntime:
         tel = self.config.telemetry
         t0n = tel.clock() if tel.enabled else 0
         t0 = time.perf_counter()
+        # black box: a PS phase that never completes (server hang, dead
+        # van) is a pending flight entry naming the phase (flight.py);
+        # the string concat only happens on the enabled path
+        frec = (tel.flight.start("ps", "ps:" + name)
+                if tel.enabled else None)
         try:
             yield
         finally:
             self.times[name] += time.perf_counter() - t0
+            tel.flight_complete(frec)
             if tel.enabled:
                 t1n = tel.clock()
                 tel.complete("ps:" + name, t0n, t1n)
@@ -370,7 +376,8 @@ class PSRuntime:
                 with sub._compile_span(key):
                     sub._infer_shapes(feed_map)
                     sub._ensure_state(executor)
-                    sub.compiled[key] = sub._compile_step()
+                    sub.compiled[key] = sub._compile_step(
+                        sub.trace_args(executor, feed_map))
             fn = sub.compiled[key]
             outputs, new_params, new_state, new_opt, ps_grads = fn(
                 *sub.trace_args(executor, feed_map))

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -31,19 +32,60 @@ __all__ = ["ServingHTTPServer"]
 
 
 class ServingHTTPServer:
+    """``slo_p99_ms`` / ``slo_error_rate`` make ``/healthz`` an SLO
+    probe: over a rolling window of the last ``slo_window`` requests,
+    a breached latency p99 or error rate flips the endpoint to 503 —
+    the signal a load balancer needs to drain a degraded replica
+    *before* users notice, instead of a liveness-only 200 that stays
+    green while every request times out. With neither SLO configured
+    ``/healthz`` keeps its plain-liveness behavior."""
+
     def __init__(self, backend, host="127.0.0.1", port=0, telemetry=None,
-                 request_timeout_s=60.0):
+                 request_timeout_s=60.0, slo_p99_ms=None,
+                 slo_error_rate=None, slo_window=128):
         self.backend = backend
         self.telemetry = _telemetry.resolve(telemetry)
         self.host = host
         self.port = int(port)
         self.request_timeout_s = float(request_timeout_s)
+        self.slo_p99_ms = slo_p99_ms
+        self.slo_error_rate = slo_error_rate
+        self._window = deque(maxlen=int(slo_window))  # (ok, latency_ms)
+        self._window_lock = threading.Lock()
         self._httpd = None
         self._thread = None
         # the session backend is NOT thread-safe (shape inference writes
         # on shared graph nodes); ThreadingHTTPServer handlers must
         # single-flight it. The batcher backend serializes internally.
         self._backend_lock = threading.Lock()
+
+    def _note_request(self, ok, ms):
+        with self._window_lock:
+            self._window.append((bool(ok), float(ms)))
+
+    def health(self):
+        """(healthy, reason) under the configured SLOs."""
+        if self.slo_p99_ms is None and self.slo_error_rate is None:
+            return True, "ok"
+        with self._window_lock:
+            window = list(self._window)
+        if not window:
+            return True, "ok (no traffic)"
+        if self.slo_error_rate is not None:
+            rate = sum(1 for ok, _ in window if not ok) / len(window)
+            if rate > self.slo_error_rate:
+                return False, (f"error rate {rate:.3f} > SLO "
+                               f"{self.slo_error_rate:.3f} over "
+                               f"{len(window)} requests")
+        if self.slo_p99_ms is not None:
+            lats = [ms for ok, ms in window if ok]
+            if lats:
+                p99 = float(np.percentile(lats, 99))
+                if p99 > self.slo_p99_ms:
+                    return False, (f"serve_latency_ms p99 {p99:.1f} > "
+                                   f"SLO {self.slo_p99_ms:.1f} over "
+                                   f"{len(lats)} requests")
+        return True, "ok"
 
     # ------------------------------------------------------------------
     def _predict(self, inputs):
@@ -76,7 +118,12 @@ class ServingHTTPServer:
             def do_GET(self):                           # noqa: N802
                 path = self.path.rstrip("/")
                 if path == "/healthz":
-                    self._reply(200, {"ok": True})
+                    healthy, reason = server.health()
+                    # healthy keeps the plain liveness body (pinned by
+                    # tests); the breach reason rides the 503 only
+                    self._reply(200 if healthy else 503,
+                                {"ok": True} if healthy
+                                else {"ok": False, "reason": reason})
                 elif path == "/metrics":
                     tel = server.telemetry
                     if not tel.enabled:
@@ -102,12 +149,16 @@ class ServingHTTPServer:
                             "{feed_name: nested_list}")
                     outs = server._predict(inputs)
                 except (ValueError, KeyError, TypeError) as e:
+                    # client errors don't count against the error SLO
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                     return
                 except Exception as e:                  # noqa: BLE001
+                    server._note_request(
+                        False, (time.perf_counter() - t0) * 1e3)
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 ms = (time.perf_counter() - t0) * 1e3
+                server._note_request(True, ms)
                 if server.telemetry.enabled:
                     server.telemetry.observe("http_request_ms", ms)
                 self._reply(200, {"outputs": outs,

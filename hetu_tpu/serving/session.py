@@ -181,8 +181,17 @@ class InferenceSession:
                 seq_pads.setdefault(sb, set()).add(s)
                 v = _pad_axis(v, sb, self.seq_axis)
             padded[node] = v
+        # black box: a predict that never returns (wedged PS pull, hung
+        # device) is a pending flight entry carrying the bucket size;
+        # tag/byte-sum construction stays off the disabled hot path
+        frec = None
+        if self.telemetry.enabled:
+            frec = self.telemetry.flight.start(
+                "serve", "serve_predict", tag=f"bucket{b}",
+                nbytes=sum(int(v.nbytes) for v in padded.values()))
         outs = self.executor.run("default", feed_dict=padded,
                                  convert_to_numpy_ret_vals=True)
+        self.telemetry.flight_complete(frec)
         if unpad:
             outs = [self._trim(o, n, b, seq_pads) for o in outs]
         tel = self.telemetry

@@ -31,9 +31,11 @@ import os
 from .tracer import Tracer, merge_traces
 from .metrics import MetricsRegistry, uptime_gauge
 from .check import validate
+from .flight import FlightRecorder, install_crash_handlers
 
-__all__ = ["Telemetry", "Tracer", "MetricsRegistry", "merge_traces",
-           "validate", "get_telemetry", "configure", "resolve", "NULL"]
+__all__ = ["Telemetry", "Tracer", "MetricsRegistry", "FlightRecorder",
+           "merge_traces", "validate", "get_telemetry", "configure",
+           "resolve", "NULL"]
 
 
 class _NullSpan:
@@ -67,14 +69,19 @@ class Telemetry:
         self.service = service or f"rank{self.rank}"
         self.tracer = None
         self.metrics = None
+        self.flight = None
         self._flushed_paths = []
         if self.enabled:
             self.tracer = Tracer(pid=self.rank, capacity=trace_capacity,
                                  process_name=self.service)
             self.metrics = MetricsRegistry()
+            self.flight = FlightRecorder(rank=self.rank)
         if self.enabled and self.out_dir:
             os.makedirs(self.out_dir, exist_ok=True)
             atexit.register(self.flush)
+            # black-box layer: SIGTERM / fatal-exception flight dumps +
+            # SIGUSR1 faulthandler stacks into out_dir (flight.py)
+            install_crash_handlers(self)
 
     # -- tracing ---------------------------------------------------------
     def span(self, name, **args):
@@ -111,6 +118,31 @@ class Telemetry:
             return 0
         return self.metrics.counter(name).value
 
+    # -- flight recorder (black box; flight.py) --------------------------
+    def flight_start(self, group, kind, peer=None, tag=None, nbytes=0):
+        """Record an enqueued cross-rank op; returns a record to pass
+        to ``flight_complete`` (None — allocation-free — when off)."""
+        if not self.enabled:
+            return None
+        return self.flight.start(group, kind, peer=peer, tag=tag,
+                                 nbytes=nbytes)
+
+    @staticmethod
+    def flight_complete(rec):
+        if rec is not None:
+            FlightRecorder.complete(rec)
+
+    def flight_record(self, group, kind, peer=None, tag=None, nbytes=0):
+        """One-shot already-complete event."""
+        if self.enabled:
+            self.flight.record(group, kind, peer=peer, tag=tag,
+                               nbytes=nbytes)
+
+    def flight_step(self, step_no):
+        """Mark a completed step boundary."""
+        if self.enabled:
+            self.flight.step(step_no)
+
     def serve_metrics(self, port, host="127.0.0.1"):
         if not self.enabled:
             return None
@@ -130,6 +162,10 @@ class Telemetry:
                              f"metrics_rank{self.rank}.jsonl")
         self.metrics.dump_jsonl(mpath)
         self._flushed_paths = [trace, mpath]
+        if self.flight is not None:
+            fpath = self.flight.dump(self.out_dir, reason="flush")
+            if fpath:
+                self._flushed_paths.append(fpath)
         return self._flushed_paths
 
 

@@ -1,0 +1,217 @@
+"""Post-mortem black-box analyzer:
+
+    python -m hetu_tpu.telemetry.blackbox DIR [--json]
+
+Merges the per-rank flight-record dumps (``flight_rank<r>.json``) and
+heartbeat files (``hb_rank<r>.json``) a failed ``heturun`` fleet left
+under its telemetry directory and names the guilty rank without a
+rerun:
+
+* **dead ranks** — heartbeat present but no flight dump (the process
+  died without reaching its SIGTERM/excepthook dumper: SIGKILL, OOM
+  kill, segfault) or a rank other dumps expected that left no files;
+* **first collective seq divergence** — ``collective``-group events
+  are SPMD-symmetric, so the first sequence number some rank recorded
+  that another never reached names who entered a collective the others
+  didn't;
+* **pending operations** — events enqueued but never completed (a
+  ``p2p_recv`` stuck waiting on a peer names that peer);
+* **last completed step per rank** — the MegaScale-style straggler
+  view.
+
+Exit codes: 0 = report produced, 2 = nothing to analyze.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = ["analyze", "format_report", "main"]
+
+
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _rank_of(path, prefix):
+    m = re.search(rf"{prefix}_rank(\d+)\.json$", path)
+    return int(m.group(1)) if m else None
+
+
+def analyze(tdir):
+    """Analyze one telemetry directory; returns a plain-dict report."""
+    dumps, beats = {}, {}
+    for path in glob.glob(os.path.join(tdir, "flight_rank*.json")):
+        r = _rank_of(path, "flight")
+        doc = _load_json(path)
+        if r is not None and doc is not None:
+            dumps[r] = doc
+    for path in glob.glob(os.path.join(tdir, "hb_rank*.json")):
+        r = _rank_of(path, "hb")
+        doc = _load_json(path)
+        if r is not None and doc is not None:
+            beats[r] = doc
+
+    expected = set(beats) | set(dumps)
+    for doc in list(dumps.values()) + list(beats.values()):
+        n = int(doc.get("nprocs", 0) or 0)
+        if n > 1:
+            expected |= set(range(n))
+    if not expected:
+        return None
+
+    ranks = {}
+    for r in sorted(expected):
+        hb = beats.get(r)
+        dump = dumps.get(r)
+        pending = []
+        last_seq = {}
+        if dump:
+            for ev in dump.get("events", []):
+                g = ev.get("group")
+                s = ev.get("seq", -1)
+                if g is not None and s > last_seq.get(g, -1):
+                    last_seq[g] = s
+                if ev.get("t1") is None:
+                    pending.append(ev)
+        last_step = -1
+        if dump and dump.get("last_step", -1) >= 0:
+            last_step = int(dump["last_step"])
+        elif hb:
+            last_step = int(hb.get("step", -1))
+        ranks[r] = {
+            "rank": r,
+            "heartbeat": bool(hb),
+            "heartbeat_done": bool(hb and hb.get("done")),
+            "heartbeat_time": float(hb["time"]) if hb else None,
+            "flight_dump": bool(dump),
+            "dump_reason": dump.get("reason") if dump else None,
+            "last_step": last_step,
+            "last_seq": last_seq,
+            "pending": pending,
+        }
+
+    # -- dead ranks: expected but dumped nothing -------------------------
+    dead = [r for r, info in ranks.items()
+            if not info["flight_dump"] and not info["heartbeat_done"]]
+
+    # -- first collective seq divergence ---------------------------------
+    divergence = None
+    coll_last = {r: info["last_seq"].get("collective", -1)
+                 for r, info in ranks.items() if info["flight_dump"]}
+    if len(coll_last) >= 2 and len(set(coll_last.values())) > 1:
+        floor = min(coll_last.values())
+        behind = sorted(r for r, s in coll_last.items() if s == floor)
+        ahead = sorted(r for r, s in coll_last.items() if s > floor)
+        first_extra = None
+        for r in ahead:
+            for ev in dumps[r].get("events", []):
+                if ev.get("group") == "collective" and \
+                        ev.get("seq", -1) == floor + 1:
+                    first_extra = ev
+                    break
+            if first_extra:
+                break
+        divergence = {"seq": floor + 1, "ahead": ahead, "behind": behind,
+                      "event": first_extra}
+
+    # -- straggler / suspect naming --------------------------------------
+    waited_on = sorted({ev.get("peer") for info in ranks.values()
+                        for ev in info["pending"]
+                        if isinstance(ev.get("peer"), int)})
+    suspects = sorted(set(dead))
+    if not suspects and divergence:
+        suspects = list(divergence["behind"])
+    if not suspects and waited_on:
+        suspects = waited_on
+    if not suspects:
+        steps = {r: info["last_step"] for r, info in ranks.items()
+                 if info["last_step"] >= 0}
+        if steps and len(set(steps.values())) > 1:
+            lag = min(steps.values())
+            suspects = sorted(r for r, s in steps.items() if s == lag)
+
+    return {"dir": tdir,
+            "ranks": {str(r): info for r, info in ranks.items()},
+            "dead_ranks": dead,
+            "divergence": divergence,
+            "waited_on_ranks": waited_on,
+            "suspect_ranks": suspects}
+
+
+def format_report(rep):
+    lines = [f"black box: {rep['dir']}"]
+    for key in sorted(rep["ranks"], key=int):
+        info = rep["ranks"][key]
+        r = info["rank"]
+        bits = []
+        if info["heartbeat_done"]:
+            bits.append("finished cleanly")
+        elif not info["flight_dump"]:
+            bits.append("NO flight dump"
+                        + (" (heartbeat present — died without dumping)"
+                           if info["heartbeat"] else " and NO heartbeat"))
+        else:
+            bits.append(f"dump reason: {info['dump_reason']!r}")
+        bits.append(f"last step {info['last_step']}")
+        if info["last_seq"]:
+            seqs = ", ".join(f"{g}={s}" for g, s in
+                             sorted(info["last_seq"].items()))
+            bits.append(f"last seq {seqs}")
+        lines.append(f"  rank {r}: " + "; ".join(bits))
+        for ev in info["pending"][:5]:
+            where = ev.get("tag") or ev.get("kind")
+            peer = ev.get("peer")
+            lines.append(
+                f"    PENDING {ev.get('kind')} seq={ev.get('seq')} "
+                f"tag={where!r}"
+                + (f" waiting on rank {peer}" if peer is not None else ""))
+    if rep["divergence"]:
+        d = rep["divergence"]
+        ev = d.get("event") or {}
+        lines.append(
+            f"  DIVERGENCE at collective seq {d['seq']}: rank(s) "
+            f"{d['ahead']} entered {ev.get('kind', '?')!r} that rank(s) "
+            f"{d['behind']} never did")
+    if rep["dead_ranks"]:
+        lines.append(f"  DEAD rank(s): {rep['dead_ranks']} — no flight "
+                     f"dump; killed before any handler could run")
+    if rep["suspect_ranks"]:
+        lines.append(f"  SUSPECT rank(s): {rep['suspect_ranks']}")
+    else:
+        lines.append("  no divergence or dead rank detected")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.telemetry.blackbox",
+        description="merge per-rank flight-record dumps and name the "
+                    "guilty rank")
+    parser.add_argument("dir", help="telemetry directory with "
+                                    "flight_rank*.json / hb_rank*.json")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable report")
+    args = parser.parse_args(argv)
+    rep = analyze(args.dir)
+    if rep is None:
+        print(f"{args.dir}: no flight dumps or heartbeats found",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

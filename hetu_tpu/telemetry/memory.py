@@ -1,0 +1,159 @@
+"""Memory accounting: compile-time and runtime device-memory
+attribution, and the OOM post-mortem.
+
+Three pieces:
+
+* :func:`capture_compile` — ``compiled.memory_analysis()`` (argument /
+  output / temp / generated-code bytes) captured at each jit compile;
+  the executor attaches the numbers to its ``jit_compile`` span and
+  this module mirrors them into the ``memory_*`` gauge family.
+* :func:`observe_device_memory` — per-step live/peak device bytes via
+  ``device.memory_stats()``; gracefully a no-op on backends that don't
+  report (CPU returns None) — the probe result is cached so the
+  disabled case costs one module-global check per step.
+* :func:`oom_report` — on ``RESOURCE_EXHAUSTED`` the executor calls
+  this to render a table of the largest live device buffers (named
+  parameters first) before re-raising, so the first donated step's OOM
+  names the tensor instead of just the byte count.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["capture_compile", "observe_device_memory", "oom_report",
+           "is_oom", "device_memory_stats"]
+
+_MEM_FIELDS = (
+    ("argument_size_in_bytes", "arg_bytes"),
+    ("output_size_in_bytes", "out_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "code_bytes"),
+)
+
+
+def capture_compile(tel, compiled, label=""):
+    """Extract ``compiled.memory_analysis()`` into a small dict and set
+    the ``memory_*`` gauges; returns the dict (None when the backend
+    doesn't implement the analysis). Never raises."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:           # noqa: BLE001 — backend-optional API
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, key in _MEM_FIELDS:
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if not out:
+        return None
+    if tel is not None and tel.enabled:
+        for key, v in out.items():
+            tel.set_gauge(f"memory_{key}", v)
+        if label:
+            tel.instant("memory_analysis", label=label, **out)
+    return out
+
+
+_mem_stats_available = None     # None = unprobed, False = backend silent
+
+
+def device_memory_stats():
+    """{device_id: {"bytes_in_use":, "peak_bytes_in_use":}} for devices
+    that report; {} on CPU. The first probe caches availability so the
+    unsupported path costs one global check afterwards."""
+    global _mem_stats_available
+    if _mem_stats_available is False:
+        return {}
+    import jax
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                out[d.id] = {
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(
+                        stats.get("peak_bytes_in_use",
+                                  stats.get("bytes_in_use", 0)))}
+    except Exception:           # noqa: BLE001 — backend-optional API
+        out = {}
+    if _mem_stats_available is None:
+        _mem_stats_available = bool(out)
+    return out
+
+
+def observe_device_memory(tel):
+    """Per-step live/peak gauges (summed over local devices); no-op
+    when telemetry is off or the backend doesn't report."""
+    if tel is None or not tel.enabled:
+        return
+    stats = device_memory_stats()
+    if not stats:
+        return
+    tel.set_gauge("memory_live_bytes",
+                  sum(s["bytes_in_use"] for s in stats.values()))
+    tel.set_gauge("memory_peak_bytes",
+                  sum(s["peak_bytes_in_use"] for s in stats.values()))
+
+
+# ---------------------------------------------------------------------------
+# OOM post-mortem
+# ---------------------------------------------------------------------------
+
+def is_oom(exc):
+    """Does this exception look like a device allocator failure?"""
+    return "RESOURCE_EXHAUSTED" in repr(exc) or "Out of memory" in repr(exc)
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.1f}{unit}" if unit != "B" else f"{n}{unit}")
+        n /= 1024.0
+    return f"{n}B"
+
+
+def oom_report(named_params=None, limit=20, out_dir=None, rank=0):
+    """Table of the largest live device buffers, named parameters
+    labelled by name; returns the rendered text and (best effort)
+    writes ``oom_rank<r>.txt`` into ``out_dir``. Never raises."""
+    try:
+        import jax
+        by_ptr = {}
+        if named_params:
+            for name, arr in named_params.items():
+                by_ptr[id(arr)] = name
+        rows = []
+        for arr in jax.live_arrays():
+            nbytes = int(getattr(arr, "nbytes", 0))
+            rows.append((nbytes, by_ptr.get(id(arr), "<activation/temp>"),
+                         str(getattr(arr, "shape", "?")),
+                         str(getattr(arr, "dtype", "?"))))
+        rows.sort(reverse=True)
+        total = sum(r[0] for r in rows)
+        lines = [f"device OOM post-mortem: {len(rows)} live buffers, "
+                 f"{_fmt_bytes(total)} total; largest {limit}:",
+                 f"{'bytes':>12}  {'shape':<20} {'dtype':<10} name"]
+        for nbytes, name, shape, dtype in rows[:limit]:
+            lines.append(f"{_fmt_bytes(nbytes):>12}  {shape:<20} "
+                         f"{dtype:<10} {name}")
+        stats = device_memory_stats()
+        for did, s in sorted(stats.items()):
+            lines.append(f"device {did}: live "
+                         f"{_fmt_bytes(s['bytes_in_use'])}, peak "
+                         f"{_fmt_bytes(s['peak_bytes_in_use'])}")
+        text = "\n".join(lines)
+        if out_dir:
+            try:
+                os.makedirs(out_dir, exist_ok=True)
+                with open(os.path.join(out_dir,
+                                       f"oom_rank{rank}.txt"), "w") as f:
+                    f.write(text + "\n")
+            except OSError:
+                pass
+        return text
+    except Exception:           # noqa: BLE001 — never mask the OOM
+        return "device OOM post-mortem unavailable"

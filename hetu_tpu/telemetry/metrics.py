@@ -182,17 +182,22 @@ class MetricsRegistry:
         registry = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):                           # noqa: N802
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
-                body = registry.to_prometheus().encode()
+            def _reply(self, body, ctype):
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):                           # noqa: N802
+                path = self.path.rstrip("/")
+                if path == "/healthz":
+                    self._reply(b'{"ok": true}', "application/json")
+                elif path in ("", "/metrics"):
+                    self._reply(registry.to_prometheus().encode(),
+                                "text/plain; version=0.0.4")
+                else:
+                    self.send_error(404)
 
             def log_message(self, *a):                  # quiet
                 pass
@@ -203,10 +208,18 @@ class MetricsRegistry:
                          daemon=True).start()
         return self._server.server_address[1]
 
-    def close(self):
+    def shutdown(self):
+        """Stop the scrape server AND release its listening socket —
+        the clean form (close() kept as an alias for existing callers).
+        A second fleet reusing the port must not hit TIME_WAIT on a
+        socket the old registry still holds open."""
         if self._server is not None:
             self._server.shutdown()
+            self._server.server_close()
             self._server = None
+
+    def close(self):
+        self.shutdown()
 
 
 def uptime_gauge(registry, name="process_uptime_seconds"):

@@ -1,0 +1,139 @@
+"""Benchmark regression gate:
+
+    python -m hetu_tpu.telemetry.regress OLD.json NEW.json --tolerance 0.15
+
+Compares two ``BENCH_*.json`` files (or raw bench JSONL output)
+metric-by-metric and exits nonzero when any metric regressed past the
+tolerance — the check CI runs so a perf PR can't silently give back a
+previous PR's win.
+
+Metric direction is inferred from the unit: ``ms/...`` and plain time
+units regress when the value goes UP; ``.../sec...`` throughput units
+regress when it goes DOWN. ``error`` units and metrics present in only
+one file are reported but never fail the gate (a new benchmark is not
+a regression).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["load_metrics", "compare", "main"]
+
+_LOWER_IS_BETTER = ("ms", "seconds", "s/step", "s/epoch")
+_HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
+
+
+def _metric_lines(text):
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    return out
+
+
+def load_metrics(path):
+    """{metric: record} from a BENCH_*.json driver file (metric JSONL
+    in its ``tail``), a raw JSONL dump, or a JSON list of records."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return _metric_lines(text)          # raw JSONL
+    if isinstance(doc, dict) and "metric" in doc and "value" in doc:
+        return {doc["metric"]: doc}
+    if isinstance(doc, dict):               # BENCH_*.json driver format
+        return _metric_lines(doc.get("tail", ""))
+    if isinstance(doc, list):
+        return {rec["metric"]: rec for rec in doc
+                if isinstance(rec, dict) and "metric" in rec}
+    return {}
+
+
+def _lower_is_better(unit):
+    # time units first: "ms/step" must not trip the "/sec" throughput
+    # match by substring accident
+    u = (unit or "").lower()
+    if u.startswith(("ms", "s/", "us", "ns")) or \
+            any(k in u for k in _LOWER_IS_BETTER):
+        return True
+    if any(k in u for k in _HIGHER_IS_BETTER) or u.endswith("/s"):
+        return False
+    return False            # unknown units treated as throughput-like
+
+
+def compare(old, new, tolerance):
+    """[(metric, old, new, ratio, status)] — status in
+    {'ok', 'improved', 'REGRESSED', 'new', 'removed', 'skipped'}."""
+    rows = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o is None:
+            rows.append((name, None, n["value"], None, "new"))
+            continue
+        if n is None:
+            rows.append((name, o["value"], None, None, "removed"))
+            continue
+        unit = n.get("unit") or o.get("unit")
+        if unit == "error" or o.get("unit") == "error":
+            rows.append((name, o.get("value"), n.get("value"), None,
+                         "skipped"))
+            continue
+        ov, nv = float(o["value"]), float(n["value"])
+        if ov == 0:
+            rows.append((name, ov, nv, None, "skipped"))
+            continue
+        # ratio > 1 means NEW is better, whatever the direction
+        ratio = (ov / nv) if _lower_is_better(unit) else (nv / ov)
+        if ratio < 1.0 - tolerance:
+            status = "REGRESSED"
+        elif ratio > 1.0 + tolerance:
+            status = "improved"
+        else:
+            status = "ok"
+        rows.append((name, ov, nv, ratio, status))
+    return rows
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.telemetry.regress",
+        description="compare two bench result files metric-by-metric; "
+                    "exit 1 on regression")
+    parser.add_argument("old", help="baseline BENCH_*.json (or JSONL)")
+    parser.add_argument("new", help="candidate BENCH_*.json (or JSONL)")
+    parser.add_argument("--tolerance", type=float, default=0.15,
+                        help="relative slack before a metric counts as "
+                             "regressed (default 0.15)")
+    args = parser.parse_args(argv)
+    old, new = load_metrics(args.old), load_metrics(args.new)
+    if not old or not new:
+        print(f"no metrics parsed ({args.old}: {len(old)}, "
+              f"{args.new}: {len(new)})", file=sys.stderr)
+        return 2
+    rows = compare(old, new, args.tolerance)
+    regressed = 0
+    for name, ov, nv, ratio, status in rows:
+        if status in ("new", "removed", "skipped"):
+            print(f"{status:>10}  {name}")
+            continue
+        if status == "REGRESSED":
+            regressed += 1
+        print(f"{status:>10}  {name}  {ov:g} -> {nv:g}  "
+              f"(x{ratio:.3f} vs tolerance {1 - args.tolerance:.2f})")
+    print(f"{regressed} regression(s) past tolerance "
+          f"{args.tolerance:g} over {len(rows)} metric(s)")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,9 +137,42 @@ class Tracer:
         return path
 
 
+def _salvage_events(text):
+    """Best-effort parse of a truncated trace file: decode whole event
+    objects from the ``traceEvents`` array until the JSON breaks off,
+    and keep that valid prefix. A rank that crashed or was killed mid-
+    export must not fail the whole fleet's merge."""
+    idx = text.find('"traceEvents"')
+    start = text.find("[", idx if idx >= 0 else 0)
+    if start < 0:
+        return []
+    decoder = json.JSONDecoder()
+    events, pos = [], start + 1
+    n = len(text)
+    while pos < n:
+        while pos < n and text[pos] in ", \t\r\n":
+            pos += 1
+        if pos >= n or text[pos] == "]":
+            break
+        try:
+            obj, pos = decoder.raw_decode(text, pos)
+        except ValueError:
+            break               # torn tail: keep the prefix
+        if isinstance(obj, dict):
+            events.append(obj)
+    return events
+
+
 def _load_events(path):
-    with open(path) as f:
-        doc = json.load(f)
+    with open(path, errors="replace") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        events = _salvage_events(text)
+        print(f"telemetry: WARNING {path} is truncated/corrupt — "
+              f"salvaged {len(events)} events from the valid prefix")
+        return events
     return doc["traceEvents"] if isinstance(doc, dict) else doc
 
 

@@ -30,3 +30,36 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On test failure, copy any telemetry / black-box files the test's
+    tmp_path left behind (merged traces, flight dumps, heartbeats,
+    stack logs) into $HETU_TEST_ARTIFACTS/<testname>/ — CI uploads that
+    directory as an artifact when the job fails, so a red distributed
+    test ships its own post-mortem instead of just a log tail."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    dest_root = os.environ.get("HETU_TEST_ARTIFACTS")
+    tmp = getattr(item, "funcargs", {}).get("tmp_path")
+    if not dest_root or tmp is None:
+        return
+    import glob
+    import shutil
+    patterns = ("trace_*.json", "flight_rank*.json", "hb_rank*.json",
+                "stacks_*.log", "metrics_rank*.jsonl", "oom_rank*.txt")
+    found = []
+    for pat in patterns:
+        found += glob.glob(os.path.join(str(tmp), "**", pat),
+                           recursive=True)
+    for src in found:
+        dst = os.path.join(dest_root, item.name,
+                           os.path.relpath(src, str(tmp)))
+        try:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy2(src, dst)
+        except OSError:
+            pass                    # artifact salvage is best effort
