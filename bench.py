@@ -417,6 +417,85 @@ def bench_wdl_ps():
         ps_server.shutdown_server()
 
 
+def bench_wdl_ps_host():
+    """Wide&Deep Criteo through the reference's DEFAULT host-path PS
+    flow: no device cache — every step sparse-pulls the rows this batch
+    needs, feeds them to the compiled step, and pushes gradients back,
+    all on the critical path. BSP (synchronous DDPushPull + barrier) and
+    ASP (accumulate-and-swap) variants at 1 server + 1 worker. Emitted
+    beside the HET-path metric (bench_wdl_ps) with the same h2d_MBps /
+    bytes_per_step attribution, so the device-cache speedup is
+    quantified in-repo instead of asserted."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+    from hetu_tpu.models.ctr import wdl_criteo
+    from hetu_tpu.ps import server as ps_server
+    from hetu_tpu.ps import client as ps_client
+
+    for variant, bsp in (("asp", False), ("bsp", True)):
+        port = ps_server.pick_free_port()
+        os.environ["HETU_PS_PORTS"] = str(port)
+        os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+        ps_server.ensure_server(port=port, nworkers=1)
+        client = ps_client.PSClient(rank=0, nworkers=1)
+        ps_client.set_default_client(client)
+        try:
+            batch = 128
+            rng = np.random.RandomState(0)
+            dense = ht.Variable("dense_input", trainable=False)
+            sparse = ht.Variable("sparse_input", trainable=False)
+            y_ = ht.Variable("y_", trainable=False)
+            loss, y, y_, train_op = wdl_criteo(
+                dense, sparse, y_, feature_dimension=1_000_000)
+            # host path: NO cstable_policy — per-step SparsePull/Push
+            exe = Executor([loss, train_op], comm_mode="PS", bsp=bsp)
+            ncycle = 50
+            zipf = ((rng.zipf(1.3, size=(ncycle, batch, 26)) - 1)
+                    % 1_000_000).astype(np.int32)
+            dense_in = rng.randn(batch, 13).astype("f")
+            y_in = rng.randint(0, 2, (batch, 1)).astype("f")
+            bytes_per_step = (zipf[0].nbytes + dense_in.nbytes
+                              + y_in.nbytes)
+
+            def feed(i):
+                return {dense: dense_in, sparse: zipf[i % ncycle],
+                        y_: y_in}
+
+            c0 = _compiles()
+            for i in range(10):                  # warm + compile
+                out = exe.run(feed_dict=feed(i))
+            out[0].asnumpy()
+            # host path dispatches per step (no scan block) — every
+            # pull/push is on the critical path by design
+            steps, windows = 60, 3
+            sps_all = []
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    out = exe.run(feed_dict=feed(i))
+                out[0].asnumpy()
+                sps_all.append(steps * batch
+                               / (time.perf_counter() - t0))
+            samples = _step_samples(
+                lambda: exe.run(feed_dict=feed(0)),
+                lambda out: out[0].asnumpy(), 8)
+            emit(f"wdl_criteo_ps_host_{variant}_samples_per_sec_per_chip",
+                 float(np.median(sps_all)), "samples/sec/chip",
+                 float(np.median(sps_all)) / WDL_BASELINE_SPS,
+                 best=float(max(sps_all)), workers=1, servers=1,
+                 h2d_MBps=h2d_probe_mbps(),
+                 bytes_per_step=bytes_per_step,
+                 jit_compiles=_compiles() - c0, **_pctl(samples),
+                 note="host path: per-step SparsePull/Push on the "
+                      "critical path; compare wdl_criteo_ps for the "
+                      "device-cache speedup")
+            exe.close()
+        finally:
+            client.shutdown_servers()
+            ps_client.close_default_client()
+            ps_server.shutdown_server()
+
+
 def bench_wdl_hybrid():
     """Wide&Deep Criteo, Hybrid mode: dense params in-graph (AllReduce
     across chips; local on one), embedding via the PS device cache — the
@@ -1256,10 +1335,44 @@ def bench_bert_long_seq():
     flops = bert_train_flops(batch, seq_len, 512, 4, 8, 2048, vocab)
     samples = _step_samples(lambda: exe.run(feed_dict=feeds),
                             lambda out: out[0].asnumpy(), 8)
+    # autotune evidence + fwd/bwd/remainder attribution: which (bq, bk)
+    # the flash kernels chose for this shape, how much of the step the
+    # tuned kernels account for, and whether the residual gap is kernel
+    # or XLA-remainder (ISSUE 5 acceptance — recorded in BENCH_r06)
+    extra = {}
+    try:
+        import jax
+        from hetu_tpu import tune
+        tel = _telemetry()
+        extra["autotune_sweeps"] = tel.counter_value("autotune_sweeps")
+        extra["autotune_cache_hits"] = tel.counter_value(
+            "autotune_cache_hit")
+        blocks = {"|".join(ks.split("|")[1:]): list(cfg) for ks, cfg
+                  in tune.chosen_configs(prefix="flash_").items()
+                  if "S2048" in ks}
+        if blocks:
+            extra["tuned_blocks"] = blocks
+        if jax.default_backend() == "tpu":
+            pr = tune.probe_attention(batch, 8, seq_len, 64,
+                                      dtype="bfloat16", sm_scale=0.125,
+                                      causal=False, has_mask=True)
+            att = tune.attribute_step(dt / steps * 1000, 4,
+                                      pr["fwd_lse_ms"], pr["bwd_ms"])
+            extra.update(
+                attn_fwd_ms=att["attn_fwd_ms"],
+                attn_bwd_ms=att["attn_bwd_ms"],
+                xla_remainder_ms=att["xla_remainder_ms"],
+                attn_fraction=att["attn_fraction"],
+                kernel_ms_tuned={"fwd_lse": pr["fwd_lse_ms"],
+                                 "bwd": pr["bwd_ms"]},
+                kernel_ms_static={"fwd_lse": pr["static_fwd_lse_ms"],
+                                  "bwd": pr["static_bwd_ms"]})
+    except Exception as e:                          # noqa: BLE001
+        extra["probe_error"] = f"{type(e).__name__}: {e}"
     emit("bert_s2048_tokens_per_sec_per_chip", tps, "tokens/sec/chip",
          tps / BERT_BASELINE_TPS, h2d_MBps=h2d_probe_mbps(),
          jit_compiles=_compiles() - c0, **_pctl(samples),
-         **mfu_fields(flops, dt / steps))
+         **mfu_fields(flops, dt / steps), **extra)
 
 
 def main():
@@ -1276,9 +1389,9 @@ def main():
                         out_dir=os.environ.get("HETU_TELEMETRY"))
 
     units = (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
-             bench_wdl_hybrid, bench_ncf, bench_gcn, bench_serving,
-             bench_pp, bench_pp_modes, bench_bert_long_seq, bench_gpt,
-             bench_bert)
+             bench_wdl_ps_host, bench_wdl_hybrid, bench_ncf, bench_gcn,
+             bench_serving, bench_pp, bench_pp_modes,
+             bench_bert_long_seq, bench_gpt, bench_bert)
     # `python bench.py serving gpt` runs just those units (name match
     # against bench_<arg>); no args = the full suite, headline last
     import sys

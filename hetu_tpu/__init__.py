@@ -34,11 +34,11 @@ __version__ = "0.1.0"
 
 
 def __getattr__(name):
-    # lazy: serving pulls in the model zoo; training-only scripts
-    # shouldn't pay for it at import time
-    if name == "serving":
+    # lazy: serving pulls in the model zoo; tune pulls in the Pallas
+    # kernels — training-only scripts shouldn't pay at import time
+    if name in ("serving", "tune"):
         import importlib
-        return importlib.import_module(".serving", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1041,15 +1041,21 @@ class Executor:
         return out
 
     def run_batches_stream(self, blocks, name="default",
-                           convert_to_numpy_ret_vals=False):
-        """run_batches over an iterable of blocks with DOUBLE-BUFFERED
-        feeds: while block i executes on device, a lookahead thread
-        stacks and device-transfers block i+1's plain feeds (the
-        stateless half of the host phase — cache slot assignment stays
-        in order on the caller). On feed-transfer-bound PS configs this
-        hides the H2D behind compute, the same overlap the dataloader
-        prefetch ring gives epoch loops. Returns the last block's
-        results (matching a run_batches loop's final value)."""
+                           convert_to_numpy_ret_vals=False, lookahead=2):
+        """run_batches over an iterable of blocks with BUFFERED feeds:
+        while block i executes on device, a lookahead thread stacks and
+        device-transfers the next ``lookahead`` blocks' plain feeds
+        (the stateless half of the host phase — cache slot assignment
+        stays in order on the caller). On feed-transfer-bound PS
+        configs this hides the H2D behind compute, the same overlap the
+        dataloader prefetch ring gives epoch loops; ``lookahead=2``
+        (default) lets a slow tunnel link hide TWO blocks of transfer
+        behind one block of compute, ``lookahead=1`` is the classic
+        double-buffer (kept reachable for the overhead-guard test).
+        Returns the last block's results (matching a run_batches loop's
+        final value)."""
+        if lookahead < 1:
+            raise ValueError(f"lookahead must be >= 1, got {lookahead}")
         if name not in self.subexecutors and "default" in self.subexecutors:
             name = "default"
         sub = self.subexecutors[name]
@@ -1072,22 +1078,39 @@ class Executor:
                 out = self.run_batches(block, name,
                                        convert_to_numpy_ret_vals)
             return out
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
         rt = self.ps_runtime
         cur = next(blocks, None)
         if cur is None:
             return None
         out = None
+        # one worker keeps ingests ordered; a deque of up to `lookahead`
+        # pending (block, future) pairs keeps that worker fed ahead of
+        # the device, so ingest i+2 starts the moment i+1 finishes
+        # instead of waiting for block i's device execution to complete
         with ThreadPoolExecutor(max_workers=1) as pool:
             pre = rt.ingest_feeds(sub, cur)
-            while cur is not None:
+            pending = deque()
+            while len(pending) < lookahead:
                 nxt = next(blocks, None)
-                fut = (pool.submit(rt.ingest_feeds, sub, nxt)
-                       if nxt is not None else None)
+                if nxt is None:
+                    break
+                pending.append((nxt, pool.submit(rt.ingest_feeds, sub,
+                                                 nxt)))
+            while cur is not None:
                 out = rt.run_block(sub, cur, convert_to_numpy_ret_vals,
                                    pre_ingested=pre)
-                cur = nxt
-                pre = fut.result() if fut is not None else None
+                if pending:
+                    cur, fut = pending.popleft()
+                    pre = fut.result()
+                    nxt = next(blocks, None)
+                    if nxt is not None:
+                        pending.append(
+                            (nxt, pool.submit(rt.ingest_feeds, sub,
+                                              nxt)))
+                else:
+                    cur, pre = None, None
         return out
 
     def get_batch_num(self, name="default"):

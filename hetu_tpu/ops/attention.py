@@ -67,7 +67,12 @@ def prefill_attention(q, k, v, sm_scale, causal=True):
     """Dense prompt-phase attention for the serving decode path over
     ``[B, H, S, D]`` q/k/v: rides the Pallas flash kernel on TPU
     backends (blocked online softmax, no HBM score matrix), the
-    composed reference elsewhere."""
+    composed reference elsewhere. The kernel's block sizes come from
+    the autotune cache (``hetu_tpu/tune``) keyed per (S, D, dtype,
+    causal, mask) — prefill tunes apart from training because it rides
+    the plain-forward kernel (training's fused path uses the with-lse
+    forward, a different key) — and since the serving forward never
+    consumes the logsumexp residual, it skips that output write."""
     if _use_pallas():
         from .pallas_attention import flash_attention
         return flash_attention(q, k, v, None, sm_scale=sm_scale,
@@ -111,6 +116,8 @@ class FlashAttentionOp(Op):
             # (the grad op runs later in the same trace) — but only when
             # something will consume it: training at a length where the
             # fused path engages. Otherwise skip the residual write.
+            # Block sizes resolve per (S, D, dtype, causal, mask) from
+            # the autotune cache at trace time (pallas_attention.py).
             from .pallas_attention import (flash_attention,
                                            flash_attention_with_lse)
             if getattr(ectx, "training", False) and \

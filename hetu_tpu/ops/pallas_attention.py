@@ -13,10 +13,25 @@ one gridded over K blocks producing dK/dV, one over Q blocks producing
 dQ — so the S×S matrices never exist in HBM on the backward pass either
 (the property training needs for long context; D = rowsum(dO ∘ O) is a
 cheap XLA elementwise reduce outside the kernels).
+
+Block sizes are AUTOTUNED per (platform, kernel, S, D, dtype, causal,
+mask): bq/bk sweep {128, 256, 512, 1024} (clipped to divisors of S)
+independently for the forward, the forward-with-lse and the fused
+backward through ``hetu_tpu/tune`` — the sweep runs once at first
+compile of a shape, the winner persists in the autotune JSON cache, and
+``HETU_AUTOTUNE=0`` falls back to the static ``_block_sizes`` defaults
+(bq≤256, bk≤512). The backward keeps a full K/V block resident across
+its whole q-loop, so its best tiles differ from the forward's — that
+per-direction freedom is the point of tuning the three kernels apart.
+Batch/heads are NOT in the key (they only size the embarrassingly
+parallel grid axis; per-program work is S/D-shaped): the sweep times
+the first caller's b/h and later batch sizes share that winner.
 """
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "flash_attention_with_lse",
-           "flash_attention_bwd"]
+           "flash_attention_bwd", "tune_key"]
 
 NEG_INF = -1e30
 LANES = 128      # TPU minor-dim tile: residual vectors store lane-tiled
@@ -81,6 +96,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *, sm_scale,
 
 
 def _block_sizes(seq_len, head_dim):
+    """Static default tiles (the pre-autotune behavior, and the
+    ``HETU_AUTOTUNE=0`` / cache-only-miss fallback)."""
     bq = min(256, seq_len)
     while seq_len % bq:
         bq //= 2
@@ -97,6 +114,125 @@ def _supported(s, d, block_q, block_k):
     return not (s < 8 or d % 8 or s % block_q or s % block_k)
 
 
+# ---------------------------------------------------------------------------
+# block-size autotuning (engine: hetu_tpu/tune/autotune.py)
+# ---------------------------------------------------------------------------
+
+# the sweep space: every candidate is a whole multiple of the TPU tile
+# and a divisor of S (enforced by _candidates), so any (bq, bk) pair in
+# it produces a valid grid
+_CANDIDATE_BLOCKS = (128, 256, 512, 1024)
+# per-candidate timing: reps amortize the host->device dispatch latency
+# (the readback sync pays one tunnel round-trip per window, shared by
+# `reps` queued kernel executions), windows take the min over link
+# jitter — candidate deltas are ~ms, tunnel jitter can be too
+_MEASURE_REPS = 8
+_MEASURE_WINDOWS = 3
+
+
+def _candidates(s):
+    return [c for c in _CANDIDATE_BLOCKS if c <= s and s % c == 0]
+
+
+def tune_key(kind, s, d, dtype, causal, has_mask, interpret=False):
+    """(name, key) under which a flash kernel's block choice is cached —
+    shared by the tuner, the probe and the tests. ``kind`` is one of
+    ``fwd`` / ``fwd_lse`` / ``bwd``; interpret-mode entries are
+    partitioned so CPU test sweeps never pollute a TPU cache."""
+    key = (f"S{s}", f"D{d}", jnp.dtype(dtype).name,
+           "causal" if causal else "full",
+           "mask" if has_mask else "nomask")
+    if interpret:
+        key = key + ("interp",)
+    return "flash_" + kind, key
+
+
+def _measure_factory(kind, b, h, s, d, dtype, sm_scale, causal, has_mask,
+                     interpret):
+    """measure(config) -> seconds for the autotune engine. Inputs are
+    built lazily on the first call (a cache hit never pays for them)
+    with the CALLER's b/h so the sweep times the shape that triggered
+    it; timing syncs by scalar readback (docs/performance.md)."""
+    state = {}
+
+    def _inputs():
+        if state:
+            return state
+        rng = np.random.RandomState(0)
+
+        def mk():
+            return jnp.asarray(rng.randn(b, h, s, d) * 0.3, dtype)
+
+        state["q"], state["k"], state["v"] = mk(), mk(), mk()
+        state["mask"] = (jnp.zeros((b, 1, 1, s), jnp.float32)
+                         if has_mask else None)
+        if kind == "bwd":
+            # consistent o/lse from the default-block forward: random
+            # residuals would exp() into inf and time a garbage kernel
+            bq0, bk0 = _block_sizes(s, d)
+            o, lse = _flash_attention_jit(
+                state["q"], state["k"], state["v"], state["mask"],
+                sm_scale, causal, interpret, bq0, bk0, True)
+            state["o"], state["lse"], state["do"] = o, lse, mk()
+        return state
+
+    def _sync(out):
+        first = out[0] if isinstance(out, tuple) else out
+        return float(jnp.sum(first.astype(jnp.float32)))
+
+    def measure(cfg):
+        # NOTE: the engine calls measure on a dedicated sweep thread.
+        # The sweep fires at trace time of the surrounding step (the
+        # executor jits the whole graph), and jax's trace state is
+        # thread-local — on the caller's thread these jnp calls would
+        # silently become traced equations and the timings garbage.
+        bq, bk = int(cfg[0]), int(cfg[1])
+        st = _inputs()
+        if kind == "bwd":
+            def run():
+                return _flash_attention_bwd_jit(
+                    st["q"], st["k"], st["v"], st["mask"], st["o"],
+                    st["lse"], st["do"], sm_scale, causal, interpret,
+                    bq, bk)
+        else:
+            need_lse = kind == "fwd_lse"
+
+            def run():
+                return _flash_attention_jit(
+                    st["q"], st["k"], st["v"], st["mask"], sm_scale,
+                    causal, interpret, bq, bk, need_lse)
+        from ..tune import timeit
+        return timeit(run, _sync, reps=_MEASURE_REPS,
+                      windows=_MEASURE_WINDOWS)
+
+    return measure
+
+
+def _tuned_block_sizes(kind, b, h, s, d, dtype, sm_scale, causal,
+                       has_mask, interpret):
+    """(block_q, block_k) for one kernel direction: the autotuned winner
+    when tuning is on and the shape has a real sweep space, the static
+    default otherwise. Runs at trace time — once per compiled shape —
+    so steady-state steps never touch the table."""
+    default = _block_sizes(s, d)
+    cands = [(bq, bk) for bq in _candidates(s) for bk in _candidates(s)]
+    if len(cands) < 2:
+        return default              # nothing to tune (short sequences)
+    from ..tune import autotune
+    name, key = tune_key(kind, s, d, dtype, causal, has_mask, interpret)
+    cfg = autotune(name, key, cands,
+                   _measure_factory(kind, b, h, s, d, dtype, sm_scale,
+                                    causal, has_mask, interpret),
+                   default=default)
+    try:
+        bq, bk = int(cfg[0]), int(cfg[1])
+    except (TypeError, ValueError, IndexError):
+        return default
+    if bq < 8 or bk < 8 or s % bq or s % bk:
+        return default              # stale/foreign cache entry
+    return bq, bk
+
+
 def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
                     interpret=None):
     """softmax(q k^T * sm_scale + mask) v over [B, H, S, D].
@@ -109,8 +245,7 @@ def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
     if interpret is None:
         interpret = INTERPRET
     b, h, s, d = q.shape
-    block_q, block_k = _block_sizes(s, d)
-    if not _supported(s, d, block_q, block_k):
+    if not _supported(s, d, *_block_sizes(s, d)):
         from .attention import attention_reference
         m = mask
         if causal:
@@ -118,9 +253,11 @@ def flash_attention(q, k, v, mask=None, sm_scale=1.0, causal=False,
                               NEG_INF)[None, None]
             m = cmask if m is None else m + cmask
         return attention_reference(q, k, v, m, sm_scale)
-    out, _ = _flash_attention_jit(q, k, v, mask, sm_scale, causal,
-                                  interpret)
-    return out
+    block_q, block_k = _tuned_block_sizes(
+        "fwd", b, h, s, d, q.dtype, sm_scale, causal, mask is not None,
+        interpret)
+    return _flash_attention_jit(q, k, v, mask, sm_scale, causal,
+                                interpret, block_q, block_k, False)
 
 
 def flash_attention_with_lse(q, k, v, mask=None, sm_scale=1.0,
@@ -131,12 +268,13 @@ def flash_attention_with_lse(q, k, v, mask=None, sm_scale=1.0,
     if interpret is None:
         interpret = INTERPRET
     b, h, s, d = q.shape
-    block_q, block_k = _block_sizes(s, d)
-    if not _supported(s, d, block_q, block_k):
+    if not _supported(s, d, *_block_sizes(s, d)):
         return None, None
-    out, lse = _flash_attention_jit(q, k, v, mask, sm_scale, causal,
-                                    interpret)
-    return out, lse
+    block_q, block_k = _tuned_block_sizes(
+        "fwd_lse", b, h, s, d, q.dtype, sm_scale, causal,
+        mask is not None, interpret)
+    return _flash_attention_jit(q, k, v, mask, sm_scale, causal,
+                                interpret, block_q, block_k, True)
 
 
 # tests flip this to exercise the kernel without a TPU backend
@@ -150,10 +288,11 @@ def _mask_rows(mask, b, h, s):
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "causal",
-                                             "interpret"))
-def _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret):
+                                             "interpret", "block_q",
+                                             "block_k", "need_lse"))
+def _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret,
+                         block_q, block_k, need_lse):
     b, h, s, d = q.shape
-    block_q, block_k = _block_sizes(s, d)
     grid = (b * h, s // block_q)
 
     qr = q.reshape(b * h, s, d)
@@ -166,32 +305,53 @@ def _flash_attention_jit(q, k, v, mask, sm_scale, causal, interpret):
         pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
     ]
     args = [qr, kr, vr]
+    body = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                             block_k=block_k, seq_len=s, causal=causal,
+                             block_q=block_q)
     if mask is not None:
         in_specs.append(
             pl.BlockSpec((1, 1, s), lambda bh, qi, _h=h: (bh // _h, 0, 0)))
         args.append(_mask_rows(mask, b, h, s))
-        kernel = functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=s,
-            causal=causal, block_q=block_q)
+        if need_lse:
+            kernel = body
+        else:
+            def kernel(q_ref, k_ref, v_ref, mask_ref, o_ref):
+                body(q_ref, k_ref, v_ref, mask_ref, o_ref, None)
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, l_ref):
-            _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, l_ref,
-                        sm_scale=sm_scale, block_k=block_k, seq_len=s,
-                        causal=causal, block_q=block_q)
+        if need_lse:
+            def kernel(q_ref, k_ref, v_ref, o_ref, l_ref):
+                body(q_ref, k_ref, v_ref, None, o_ref, l_ref)
+        else:
+            def kernel(q_ref, k_ref, v_ref, o_ref):
+                body(q_ref, k_ref, v_ref, None, o_ref, None)
 
-    out, lse = pl.pallas_call(
+    o_shape = jax.ShapeDtypeStruct((b * h, s, d), q.dtype)
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))
+    if need_lse:
+        # the lse residual is emitted only when a consumer exists (the
+        # fused backward); the inference/serving forward skips the write
+        out, lse = pl.pallas_call(
+            kernel,
+            out_shape=[o_shape,
+                       jax.ShapeDtypeStruct((b * h, s, LANES),
+                                            jnp.float32)],
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[o_spec,
+                       pl.BlockSpec((1, block_q, LANES),
+                                    lambda bh, qi: (bh, qi, 0))],
+            interpret=interpret,
+        )(*args)
+        return out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s)
+    out = pl.pallas_call(
         kernel,
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32)],
+        out_shape=o_shape,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
+        out_specs=o_spec,
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s)
+    return out.reshape(b, h, s, d)
 
 
 # ---------------------------------------------------------------------------
@@ -285,11 +445,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, mask_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "causal",
-                                             "interpret"))
+                                             "interpret", "block_q",
+                                             "block_k"))
 def _flash_attention_bwd_jit(q, k, v, mask, o, lse, do, sm_scale, causal,
-                             interpret):
+                             interpret, block_q, block_k):
     b, h, s, d = q.shape
-    block_q, block_k = _block_sizes(s, d)
     grid_kv = (b * h, s // block_k)
     grid_q = (b * h, s // block_q)
 
@@ -379,8 +539,15 @@ def _flash_attention_bwd_jit(q, k, v, mask, o, lse, do, sm_scale, causal,
 def flash_attention_bwd(q, k, v, mask, o, lse, do, sm_scale=1.0,
                         causal=False, interpret=None):
     """(dq, dk, dv) via the fused recompute-form kernels. ``lse`` is the
-    forward's logsumexp (flash_attention_with_lse)."""
+    forward's logsumexp (flash_attention_with_lse). Block sizes tune
+    independently of the forward's: the dK/dV kernel holds one K/V block
+    resident across its whole q-loop, so it generally wants smaller bq /
+    larger bk tiles than the forward at long S."""
     if interpret is None:
         interpret = INTERPRET
+    b, h, s, d = q.shape
+    block_q, block_k = _tuned_block_sizes(
+        "bwd", b, h, s, d, q.dtype, sm_scale, causal, mask is not None,
+        interpret)
     return _flash_attention_bwd_jit(q, k, v, mask, o, lse, do, sm_scale,
-                                    causal, interpret)
+                                    causal, interpret, block_q, block_k)

@@ -1,0 +1,185 @@
+"""Segment-timing probe: where does a long-sequence step's time go?
+
+``bert_s2048`` runs at a fraction of roofline and the open question is
+"kernel or XLA remainder". This harness answers it with data instead of
+a guess: it times the tuned flash forward(+lse) and fused backward in
+isolation on the exact attention shape a model runs, times the STATIC
+default blocks beside them (the kernel-level before/after of the
+autotuner), and splits a measured full-step time into
+attention-fwd / attention-bwd / XLA-remainder.
+
+Results flow through the process-global telemetry — one ``attn_probe``
+span per probed kernel with the chosen blocks and milliseconds in its
+attrs, plus ``probe_attn_{fwd,bwd,remainder}_ms`` gauges — so the
+attribution lands in the same trace as the step it explains.
+
+CLI::
+
+    python -m hetu_tpu.tune.probe --batch 8 --heads 8 --seq 2048 \
+        --head-dim 64 --dtype bfloat16 [--causal] [--no-mask] \
+        [--step-ms 58.3 --layers 4]
+
+prints one JSON document; with ``--step-ms`` it includes the
+full-step attribution.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+__all__ = ["probe_attention", "attribute_step", "main"]
+
+
+def _telemetry():
+    from .. import telemetry
+    return telemetry.get_telemetry()
+
+
+def probe_attention(batch, heads, seq, head_dim, dtype="bfloat16",
+                    sm_scale=None, causal=False, has_mask=True,
+                    interpret=None, reps=5, include_static=True):
+    """Per-kernel milliseconds for the flash fwd(+lse)/bwd on one shape.
+
+    Returns ``{"fwd_ms", "fwd_lse_ms", "bwd_ms", "blocks": {kind:
+    (bq, bk)}}`` plus ``static_*_ms`` twins measured with the untuned
+    ``_block_sizes`` defaults when ``include_static`` (the in-repo
+    tuned-vs-static evidence). Uses the tuned path, so a cold autotune
+    cache sweeps here — which is the point: the probe pays the sweep
+    the training step would have paid, and the cache makes both free
+    afterwards."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pallas_attention as pk
+    from .autotune import timeit
+
+    if interpret is None:
+        # off-TPU the kernels only run in interpret mode; timings there
+        # are emulation, not device truth, but the plumbing still works
+        interpret = pk.INTERPRET or jax.default_backend() != "tpu"
+    dtype = jnp.dtype(dtype)
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(head_dim))
+    rng = np.random.RandomState(0)
+
+    def mk():
+        return jnp.asarray(
+            rng.randn(batch, heads, seq, head_dim) * 0.3, dtype)
+
+    q, k, v = mk(), mk(), mk()
+    mask = (jnp.zeros((batch, 1, 1, seq), jnp.float32)
+            if has_mask else None)
+
+    def sync(out):
+        first = out[0] if isinstance(out, tuple) else out
+        return float(jnp.sum(first.astype(jnp.float32)))
+
+    tel = _telemetry()
+    out = {"shape": {"batch": batch, "heads": heads, "seq": seq,
+                     "head_dim": head_dim, "dtype": dtype.name,
+                     "causal": causal, "mask": has_mask},
+           "blocks": {}}
+
+    tuned = {}
+    for kind in ("fwd", "fwd_lse", "bwd"):
+        tuned[kind] = pk._tuned_block_sizes(
+            kind, batch, heads, seq, head_dim, dtype, sm_scale, causal,
+            has_mask, interpret)
+        out["blocks"][kind] = list(tuned[kind])
+    static = pk._block_sizes(seq, head_dim)
+
+    def run_fwd(blocks, need_lse):
+        bq, bk = blocks
+        return lambda: pk._flash_attention_jit(
+            q, k, v, mask, sm_scale, causal, interpret, bq, bk,
+            need_lse)
+
+    o, lse = pk._flash_attention_jit(q, k, v, mask, sm_scale, causal,
+                                     interpret, *tuned["fwd_lse"], True)
+    do = mk()
+
+    def run_bwd(blocks):
+        bq, bk = blocks
+        return lambda: pk._flash_attention_bwd_jit(
+            q, k, v, mask, o, lse, do, sm_scale, causal, interpret,
+            bq, bk)
+
+    plan = [("fwd_ms", run_fwd(tuned["fwd"], False), tuned["fwd"]),
+            ("fwd_lse_ms", run_fwd(tuned["fwd_lse"], True),
+             tuned["fwd_lse"]),
+            ("bwd_ms", run_bwd(tuned["bwd"]), tuned["bwd"])]
+    if include_static:
+        plan += [("static_fwd_ms", run_fwd(static, False), static),
+                 ("static_fwd_lse_ms", run_fwd(static, True), static),
+                 ("static_bwd_ms", run_bwd(static), static)]
+    for name, run, blocks in plan:
+        t0 = tel.clock()
+        wall0 = time.perf_counter()
+        ms = timeit(run, sync, reps=reps, windows=2) * 1000
+        out[name] = round(ms, 4)
+        if tel.enabled:
+            tel.complete(
+                "attn_probe", t0,
+                t0 + int((time.perf_counter() - wall0) * 1e9),
+                args={"kernel": name[:-3], "ms": out[name],
+                      "blocks": str(tuple(blocks)), "seq": seq,
+                      "head_dim": head_dim, "dtype": dtype.name})
+    return out
+
+
+def attribute_step(step_ms, layers, fwd_ms, bwd_ms):
+    """Split a measured training-step time into attention-forward,
+    attention-backward and everything-else ("XLA remainder": matmuls,
+    LN, softmax head, optimizer, data movement). ``fwd_ms``/``bwd_ms``
+    are per-layer kernel times from :func:`probe_attention` — pass the
+    ``fwd_lse_ms`` twin for a training step, since that is the kernel
+    the fused-backward forward actually runs."""
+    attn_fwd = layers * float(fwd_ms)
+    attn_bwd = layers * float(bwd_ms)
+    remainder = max(0.0, float(step_ms) - attn_fwd - attn_bwd)
+    tel = _telemetry()
+    tel.set_gauge("probe_attn_fwd_ms", attn_fwd)
+    tel.set_gauge("probe_attn_bwd_ms", attn_bwd)
+    tel.set_gauge("probe_attn_remainder_ms", remainder)
+    return {"step_ms": round(float(step_ms), 3),
+            "attn_fwd_ms": round(attn_fwd, 3),
+            "attn_bwd_ms": round(attn_bwd, 3),
+            "xla_remainder_ms": round(remainder, 3),
+            "attn_fraction": round((attn_fwd + attn_bwd)
+                                   / max(float(step_ms), 1e-9), 4)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.tune.probe",
+        description="time flash attention fwd/bwd kernels in isolation "
+                    "and attribute a full step")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=2048)
+    parser.add_argument("--head-dim", type=int, default=64)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--causal", action="store_true")
+    parser.add_argument("--no-mask", dest="mask", action="store_false")
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--step-ms", type=float, default=None,
+                        help="measured full-step ms to attribute")
+    parser.add_argument("--layers", type=int, default=4)
+    args = parser.parse_args(argv)
+    out = probe_attention(args.batch, args.heads, args.seq,
+                          args.head_dim, dtype=args.dtype,
+                          causal=args.causal, has_mask=args.mask,
+                          reps=args.reps)
+    if args.step_ms is not None:
+        out["attribution"] = attribute_step(
+            args.step_ms, args.layers, out["fwd_lse_ms"], out["bwd_ms"])
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -219,6 +219,50 @@ def test_ps_stream_matches_run_batches(ps_env):
     exe2.close()
 
 
+def test_ps_stream_lookahead_depths_match(ps_env):
+    """The configurable ingest lookahead (default 2; 1 = the classic
+    double-buffer, kept reachable for the overhead guard) must train
+    identically at any depth — deeper lookahead changes WHEN feeds
+    transfer, never what the steps compute."""
+    rng = np.random.RandomState(7)
+    table = rng.randn(60, 4).astype(np.float32)
+    data = [(rng.randint(0, 60, (8, 3)),
+             rng.randn(8, 2).astype(np.float32)) for _ in range(16)]
+    blocks = [data[:4], data[4:8], data[8:12], data[12:]]
+
+    ids, y_, loss, train = _embed_model(table)
+    exe = Executor([loss, train], comm_mode="PS", cstable_policy="Device",
+                   cache_bound=5)
+    for chunk in blocks:
+        out = exe.run_batches([{ids: i, y_: y} for i, y in chunk],
+                              convert_to_numpy_ret_vals=True)
+    want_last = float(out[-1][0])
+    rt = next(iter(exe.ps_runtime.device_tables.values()))
+    exe.ps_runtime.drain()
+    want_cache = np.asarray(exe.params[rt.cache_sid]).copy()
+    exe.close()
+
+    for lookahead in (1, 3):
+        ids2, y2, loss2, train2 = _embed_model(table)
+        exe2 = Executor([loss2, train2], comm_mode="PS",
+                        cstable_policy="Device", cache_bound=5)
+        out2 = exe2.run_batches_stream(
+            ([{ids2: i, y2: y} for i, y in chunk] for chunk in blocks),
+            convert_to_numpy_ret_vals=True, lookahead=lookahead)
+        got_last = float(out2[-1][0])
+        rt2 = next(iter(exe2.ps_runtime.device_tables.values()))
+        exe2.ps_runtime.drain()
+        got_cache = np.asarray(exe2.params[rt2.cache_sid])
+        np.testing.assert_allclose(got_last, want_last, rtol=1e-5,
+                                   err_msg=f"lookahead={lookahead}")
+        np.testing.assert_allclose(got_cache, want_cache, rtol=1e-5,
+                                   err_msg=f"lookahead={lookahead}")
+        exe2.close()
+
+    with pytest.raises(ValueError, match="lookahead"):
+        exe2.run_batches_stream(iter([]), lookahead=0)
+
+
 def _softmax_model(prefix):
     """Same 1-layer softmax model under a name prefix (two fresh graphs
     with identical init values, the file's _embed_model convention)."""
