@@ -35,8 +35,9 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # lazy: serving pulls in the model zoo; tune pulls in the Pallas
-    # kernels — training-only scripts shouldn't pay at import time
-    if name in ("serving", "tune"):
+    # kernels; analysis is only needed when a graph is being verified —
+    # training-only scripts shouldn't pay at import time
+    if name in ("serving", "tune", "analysis"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,127 @@
+"""hetu_tpu.analysis — preflight graph verifier for define-then-run
+sessions.
+
+The define-then-run model hands us the *whole* program — graph,
+partition states, pipeline schedule, placement — before a single byte
+moves. This package runs four static passes over the topo-sorted graph
+between construction and first dispatch, each emitting structured
+:class:`~.findings.Finding` objects with stable codes and per-op user
+provenance:
+
+1. **shapes** (HT1xx) — shape/dtype propagation through the existing
+   ``Op.infer_shape`` protocol + dead-subgraph/unused-variable/
+   duplicate-param lint,
+2. **sharding** (HT2xx) — the planner's ``deduce_states`` fixpoint
+   validated; unmappable or conflicting specs rejected, implicit
+   reshards surfaced with comm-byte estimates,
+3. **deadlock** (HT3xx) — the GPipe/1F1B/collective schedules executed
+   symbolically rank-by-rank; unmatched sends/recvs and cyclic waits
+   become findings instead of fleet hangs,
+4. **memory** (HT4xx) — static footprint estimate (and, at compile
+   time, ``memory_analysis()`` numbers) against an HBM budget.
+
+Surfaces: ``Executor(validate="error"|"warn"|"off")``,
+``heturun --preflight``, ``python -m hetu_tpu.analysis`` (zoo CLI),
+``python -m hetu_tpu.analysis.jit_purity`` (codebase self-lint), and a
+graphboard finding overlay. See ``docs/analysis.md``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .findings import (Finding, Report, GraphValidationError, collecting,
+                       emit, provenance)
+from .shapes import shape_pass, lint_pass, frozen_graph_pass
+from .sharding import sharding_pass
+from .deadlock import deadlock_pass
+from .memory import memory_pass, check_compiled
+
+__all__ = ["Finding", "Report", "GraphValidationError", "collecting",
+           "emit", "provenance", "analyze", "finish_preflight",
+           "shape_pass", "lint_pass", "frozen_graph_pass",
+           "sharding_pass", "deadlock_pass", "memory_pass",
+           "check_compiled", "EXIT_PREFLIGHT"]
+
+# distinct exit code for "preflight found errors" (cf. the watchdog's
+# 117): the launcher refuses to spawn the fleet when it sees it
+EXIT_PREFLIGHT = 121
+
+
+def _schedule_of(config):
+    if config is None:
+        return "gpipe"
+    if getattr(config, "pipeline_mode", None) == "collective":
+        return "collective"
+    if getattr(config, "use_pipedream", False):
+        return "1f1b"
+    return "gpipe"
+
+
+def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
+            nprocs=None, num_microbatches=None, hbm_budget=None,
+            extra_roots=(), frozen=False):
+    """Run every static pass over a graph; returns a :class:`Report`.
+
+    ``config`` (a HetuConfig) refines the passes — pipeline schedule
+    selection, microbatch count — but is optional: the passes derive
+    staging and statuses from the graph itself. A pass that crashes is
+    downgraded to an HT001 warning so one broken analyzer never blocks
+    a launch the others would have cleared.
+    """
+    from ..graph.autodiff import find_topo_sort
+
+    report = Report()
+    topo = find_topo_sort(list(eval_node_list))
+    if config is not None:
+        schedule = schedule or _schedule_of(config)
+        num_microbatches = (num_microbatches
+                            or getattr(config, "num_microbatches", None))
+
+    def _guard(name, fn, *a, **kw):
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001 — analysis must not kill a launch
+            report.add("HT001", "warn",
+                       f"analysis pass {name!r} crashed "
+                       f"({type(e).__name__}: {e}) — its findings are "
+                       f"incomplete")
+            return None
+
+    shapes = _guard("shapes", shape_pass, topo, report,
+                    feed_shapes=feed_shapes) or {}
+    _guard("lint", lint_pass, topo, report,
+           eval_nodes=eval_node_list, extra_roots=extra_roots)
+    _guard("sharding", sharding_pass, topo, report, shapes=shapes)
+    _guard("deadlock", deadlock_pass, eval_node_list, report,
+           schedule=schedule or "gpipe", nprocs=nprocs,
+           num_microbatches=num_microbatches)
+    _guard("memory", memory_pass, topo, shapes, report,
+           budget=hbm_budget)
+    if frozen:
+        _guard("frozen", frozen_graph_pass, topo, report)
+    return report
+
+
+def finish_preflight(report, out_path=None):
+    """Terminal preflight action (the ``HETU_PREFLIGHT`` env contract):
+    print the report, write JSON when ``out_path`` names a file, and
+    exit the process — 0 on a clean graph, :data:`EXIT_PREFLIGHT` when
+    errors exist — *before* any fleet/PS machinery spins up."""
+    text = report.to_text()
+    print(text, file=sys.stderr if report.errors else sys.stdout)
+    if out_path and out_path not in ("1", "true"):
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                        exist_ok=True)
+            with open(out_path, "w") as f:
+                f.write(report.to_json() + "\n")
+        except OSError as e:
+            print(f"preflight: could not write {out_path}: {e}",
+                  file=sys.stderr)
+    if report.errors:
+        print("preflight: FAILED — fix the errors above before "
+              "launching", file=sys.stderr)
+        raise SystemExit(EXIT_PREFLIGHT)
+    print("preflight: OK")
+    raise SystemExit(0)

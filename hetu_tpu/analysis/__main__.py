@@ -1,0 +1,72 @@
+"""``python -m hetu_tpu.analysis`` — preflight the model zoo (or a
+saved graph) from the command line.
+
+Builds each registered zoo graph with canonical feed shapes, runs every
+static pass, and prints per-model findings. Exit status 1 when any
+model has errors — the CI preflight job's gate. ``--jit-purity`` chains
+the codebase self-lint in the same invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.analysis",
+        description="static preflight over the model zoo")
+    parser.add_argument("models", nargs="*",
+                        help="zoo model names (default: all)")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered zoo models and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--hbm-budget", default=None, metavar="BYTES",
+                        help="HBM budget for the memory pass "
+                             "(e.g. 8G, 512MiB; default: "
+                             "$HETU_HBM_BUDGET or the device limit)")
+    parser.add_argument("--jit-purity", action="store_true",
+                        help="also run the jit-purity codebase lint")
+    args = parser.parse_args(argv)
+
+    from . import analyze, zoo
+    if args.list:
+        print("\n".join(sorted(zoo.ZOO)))
+        return 0
+
+    names = args.models or sorted(zoo.ZOO)
+    unknown = [n for n in names if n not in zoo.ZOO]
+    if unknown:
+        parser.error(f"unknown zoo model(s) {unknown}; "
+                     f"--list shows the registry")
+
+    failed = []
+    for name in names:
+        eval_nodes, feed_shapes = zoo.build(name)
+        report = analyze(eval_nodes, feed_shapes=feed_shapes,
+                         hbm_budget=args.hbm_budget)
+        status = "FAIL" if report.errors else "ok"
+        print(f"== {name}: {status} ({len(report.errors)} errors, "
+              f"{len(report.warnings)} warnings)")
+        if args.json:
+            print(report.to_json())
+        else:
+            for f in report.errors + report.warnings:
+                print("   " + str(f))
+        if report.errors:
+            failed.append(name)
+
+    rc = 0
+    if failed:
+        print(f"preflight: {len(failed)}/{len(names)} zoo model(s) "
+              f"failed: {', '.join(failed)}", file=sys.stderr)
+        rc = 1
+    if args.jit_purity:
+        from .jit_purity import main as purity_main
+        rc = max(rc, purity_main([]))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,401 @@
+"""Pass 3 — static deadlock detection for pipeline schedules (HT3xx).
+
+The define-then-run model means the *entire* communication schedule is
+known before launch: stage assignment comes from device contexts
+(``parallel/pipeline.py _build_stages``), ownership from
+``_owner_of``, and the issue order from the schedule drivers
+(``_run_gpipe_multiproc`` / ``_drive_1f1b``). This pass rebuilds that
+schedule symbolically — per rank, an ordered program of send/recv/
+collective events with the same tags the runtime uses — and executes it
+over a buffered channel model (the p2p channel's reader thread drains
+sockets, so sends never block; only recvs do). A schedule that cannot
+drain is a fleet that hangs at step 0 and gets killed by PR 4's
+watchdog after ``--hang-timeout`` seconds; here it is a sub-second
+finding naming the blocked ranks. The static complement of the runtime
+flight recorder.
+
+Error codes
+-----------
+HT301  recv never satisfied (no rank ever sends the tag)        error
+HT302  cyclic wait between ranks (true deadlock)                error
+HT303  collective issue order diverges across ranks             error
+HT304  unpaired pipeline send/recv markers                      error
+HT305  send never received (orphan boundary transfer)           warn
+HT306  collective-pipeline contract violation (non-linear
+       chain, loss off the last stage, ...)                     error
+HT307  stage consumes a boundary produced by a LATER stage on
+       the same rank (forward schedule order violation)         error
+"""
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+__all__ = ["build_plan", "rank_programs", "simulate",
+           "collective_order_pass", "deadlock_pass", "Event",
+           "PipelinePlan"]
+
+
+class Event:
+    """One symbolic schedule action of a rank."""
+
+    __slots__ = ("kind", "peer", "tag", "label")
+
+    def __init__(self, kind, peer=None, tag=None, label=""):
+        self.kind = kind          # "send" | "recv" | "collective"
+        self.peer = peer          # the other rank (send dst / recv src)
+        self.tag = tag
+        self.label = label
+
+    def __repr__(self):
+        return f"Event({self.kind}, peer={self.peer}, tag={self.tag})"
+
+
+class _Stage:
+    __slots__ = ("index", "owner", "hostname", "nodes", "in_nodes",
+                 "out_nodes", "consumed_outs")
+
+    def __init__(self, index, hostname):
+        self.index = index
+        self.owner = 0
+        self.hostname = hostname
+        self.nodes = []
+        self.in_nodes = []
+        self.out_nodes = []
+        self.consumed_outs = []
+
+
+class PipelinePlan:
+    """Stage graph + ownership, mirrored from the pipeline executor's
+    ``_build_stages`` without touching devices or jits."""
+
+    def __init__(self, stages, assign, consumers, loss_node):
+        self.stages = stages
+        self.assign = assign            # node -> stage index
+        self.consumers = consumers      # node -> [consuming stages]
+        self.loss_node = loss_node
+
+    @property
+    def nranks(self):
+        return len({s.owner for s in self.stages})
+
+
+def build_plan(eval_nodes, nprocs=None):
+    """Stage the forward graph exactly as ``PipelineSubExecutor`` would;
+    returns None when fewer than two stages exist (no pipeline)."""
+    from ..graph.autodiff import find_topo_sort
+    from ..optimizer import OptimizerOp
+    from ..ops.comm import PipelineReceiveOp, PipelineSendOp
+    from ..ops.variable import PlaceholderOp
+    from ..parallel.pipeline import _device_key, _owner_of
+
+    eval_fwd = [n for n in eval_nodes if not isinstance(n, OptimizerOp)]
+    if not eval_fwd:
+        return None
+    topo = [n for n in find_topo_sort(eval_fwd)
+            if not isinstance(n, (PipelineSendOp, PipelineReceiveOp))]
+
+    keys = []
+    for node in topo:
+        k = _device_key(node)
+        if k is not None and k not in keys \
+                and not isinstance(node, PlaceholderOp):
+            keys.append(k)
+    if len(keys) < 2:
+        return None
+    key_to_stage = {k: i for i, k in enumerate(keys)}
+    stages = [_Stage(i, k[0][0]) for i, k in enumerate(keys)]
+
+    assign = {}
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            continue
+        s = key_to_stage.get(_device_key(node))
+        if s is None:
+            s = max((assign.get(i, 0) for i in node.inputs), default=0)
+        assign[node] = s
+        stages[s].nodes.append(node)
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            consumers = [assign[n] for n in topo
+                         if not isinstance(n, PlaceholderOp)
+                         and node in n.inputs]
+            assign[node] = min(consumers) if consumers else 0
+
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            continue
+        s = assign[node]
+        for inp in node.inputs:
+            si = assign.get(inp, s)
+            if si != s and not isinstance(inp, PlaceholderOp):
+                if inp not in stages[s].in_nodes:
+                    stages[s].in_nodes.append(inp)
+                if inp not in stages[si].out_nodes:
+                    stages[si].out_nodes.append(inp)
+    loss_node = eval_fwd[0]
+    for ev in eval_fwd:
+        s = assign.get(ev)
+        if s is not None and ev not in stages[s].out_nodes:
+            stages[s].out_nodes.append(ev)
+    all_ins = set()
+    for st in stages:
+        all_ins.update(st.in_nodes)
+    for st in stages:
+        st.consumed_outs = [n for n in st.out_nodes if n in all_ins]
+
+    if nprocs is None:
+        nprocs = int(os.environ.get("HETU_NUM_PROCS", "1"))
+    for st in stages:
+        st.owner = _owner_of(st.hostname, nprocs)
+
+    consumers = {}
+    for st in stages:
+        for node in st.in_nodes:
+            consumers.setdefault(node, []).append(st)
+    return PipelinePlan(stages, assign, consumers, loss_node)
+
+
+# ---------------------------------------------------------------------------
+# schedule -> per-rank symbolic programs
+# ---------------------------------------------------------------------------
+
+def _fwd_events(plan, progs, report, m=None):
+    """One forward sweep (stage order) — the shared shape of the GPipe
+    block phase and each 1F1B ``forward(m)``."""
+    stages = plan.stages
+    for stage in stages:
+        r = stage.owner
+        for node in stage.in_nodes:
+            src = stages[plan.assign[node]]
+            if src.owner != r:
+                progs[r].append(Event(
+                    "recv", peer=src.owner, tag=("f", m, node.id,
+                                                 stage.index),
+                    label=f"{node.name} (stage {stage.index} <- stage "
+                          f"{src.index})"))
+            elif src.index > stage.index and report is not None \
+                    and m in (None, 0):
+                # structural finding: report once, not once per microbatch
+                report.add(
+                    "HT307", "error",
+                    f"stage {stage.index} consumes {node.name} produced "
+                    f"by LATER stage {src.index} on the same rank — the "
+                    f"forward schedule runs stages in order and would "
+                    f"read an unset boundary", node=node)
+        for node in stage.consumed_outs:
+            for cons in plan.consumers.get(node, ()):
+                if cons.owner != r:
+                    progs[r].append(Event(
+                        "send", peer=cons.owner,
+                        tag=("f", m, node.id, cons.index),
+                        label=f"{node.name} (stage {stage.index} -> "
+                              f"stage {cons.index})"))
+
+
+def _bwd_events(plan, progs, m=None):
+    """One backward sweep (reverse stage order): cotangent recvs from
+    foreign consumers, cotangent sends to foreign producers."""
+    stages = plan.stages
+    for stage in reversed(stages):
+        r = stage.owner
+        for node in stage.out_nodes:
+            for cons in plan.consumers.get(node, ()):
+                if cons.owner != r:
+                    progs[r].append(Event(
+                        "recv", peer=cons.owner,
+                        tag=("b", m, node.id, cons.index),
+                        label=f"cotangent of {node.name} (stage "
+                              f"{stage.index} <- stage {cons.index})"))
+        for node in stage.in_nodes:
+            src = stages[plan.assign[node]]
+            if src.owner != r:
+                progs[r].append(Event(
+                    "send", peer=src.owner,
+                    tag=("b", m, node.id, stage.index),
+                    label=f"cotangent of {node.name} (stage "
+                          f"{stage.index} -> stage {src.index})"))
+
+
+def rank_programs(plan, schedule="gpipe", num_microbatches=None,
+                  report=None):
+    """Per-rank ordered event programs for a schedule, mirroring the
+    runtime drivers (``_run_gpipe_multiproc`` issue order for gpipe;
+    the exact ``_drive_1f1b`` interleaving for 1f1b)."""
+    from ..ops.comm import AllReduceCommunicateOp
+    from ..parallel.pipeline import _drive_1f1b
+
+    ranks = sorted({s.owner for s in plan.stages})
+    progs = {r: [] for r in ranks}
+    S = len(plan.stages)
+    M = num_microbatches or max(2, S)
+
+    # in-stage collectives (TP inside a stage): record issue order so a
+    # rank pair sharing a stage group can be cross-checked
+    for stage in plan.stages:
+        for node in stage.nodes:
+            if isinstance(node, AllReduceCommunicateOp):
+                progs[stage.owner].append(Event(
+                    "collective", tag=node.op_type, label=node.name))
+
+    if schedule == "gpipe":
+        _fwd_events(plan, progs, report)
+        _bwd_events(plan, progs)
+    else:                               # 1f1b — replay the real driver
+        _drive_1f1b(lambda m: _fwd_events(plan, progs, report, m=m),
+                    lambda m: _bwd_events(plan, progs, m=m), S, M)
+    return progs
+
+
+# ---------------------------------------------------------------------------
+# symbolic execution
+# ---------------------------------------------------------------------------
+
+def simulate(programs, report):
+    """Execute the per-rank programs over a buffered channel; report
+    HT301/HT302/HT305. Returns True when every program drains."""
+    pcs = {r: 0 for r in programs}
+    chan = Counter()
+    progressed = True
+    while progressed:
+        progressed = False
+        for r, prog in programs.items():
+            while pcs[r] < len(prog):
+                ev = prog[pcs[r]]
+                if ev.kind == "send":
+                    chan[(ev.peer, ev.tag)] += 1
+                elif ev.kind == "recv":
+                    if chan[(r, ev.tag)] <= 0:
+                        break
+                    chan[(r, ev.tag)] -= 1
+                pcs[r] += 1
+                progressed = True
+
+    blocked = {r: prog[pcs[r]] for r, prog in programs.items()
+               if pcs[r] < len(prog)}
+    for r, ev in sorted(blocked.items()):
+        # does ANY rank still hold a future send matching this recv?
+        sender = None
+        for r2, prog in programs.items():
+            for e2 in prog[pcs[r2]:]:
+                if e2.kind == "send" and e2.peer == r \
+                        and e2.tag == ev.tag:
+                    sender = r2
+                    break
+            if sender is not None:
+                break
+        if sender is None:
+            report.add(
+                "HT301", "error",
+                f"rank {r} blocks forever waiting to receive "
+                f"{ev.label} from rank {ev.peer}: no rank ever sends "
+                f"it — mis-paired pipeline send/recv")
+        else:
+            peer_ev = blocked.get(sender)
+            peer_txt = (f"rank {sender} is itself blocked waiting to "
+                        f"receive {peer_ev.label} from rank "
+                        f"{peer_ev.peer}" if peer_ev is not None
+                        else f"rank {sender} would send it only later "
+                        f"in its schedule")
+            report.add(
+                "HT302", "error",
+                f"static deadlock: rank {r} waits to receive "
+                f"{ev.label} from rank {sender}, while {peer_txt} — "
+                f"cyclic wait, the fleet would hang at this step")
+    if not blocked:
+        for (dst, tag), n in chan.items():
+            if n > 0:
+                report.add(
+                    "HT305", "warn",
+                    f"{n} boundary send(s) to rank {dst} (tag {tag}) "
+                    f"are never received — dead transfer, likely a "
+                    f"stale or duplicated pipeline marker")
+    return not blocked
+
+
+def collective_order_pass(programs, report):
+    """Every rank participating in collectives must issue the identical
+    sequence — a divergence is a guaranteed cross-rank hang (HT303)."""
+    seqs = {r: [ev.tag for ev in prog if ev.kind == "collective"]
+            for r, prog in programs.items()}
+    seqs = {r: s for r, s in seqs.items() if s}
+    if len(seqs) < 2:
+        return
+    ranks = sorted(seqs)
+    ref_rank, ref = ranks[0], seqs[ranks[0]]
+    for r in ranks[1:]:
+        s = seqs[r]
+        if s == ref:
+            continue
+        k = next((i for i, (a, b) in enumerate(zip(ref, s)) if a != b),
+                 min(len(ref), len(s)))
+        a = ref[k] if k < len(ref) else "<end of schedule>"
+        b = s[k] if k < len(s) else "<end of schedule>"
+        report.add(
+            "HT303", "error",
+            f"collective issue order diverges: rank {ref_rank} issues "
+            f"{a} as collective #{k} but rank {r} issues {b} — the "
+            f"fleet deadlocks at the first mismatched collective")
+
+
+def _collective_chain_pass(plan, report):
+    """Static form of CollectiveGPipe's linear-chain contract (the
+    builder raises at trace time; preflight reports before launch)."""
+    S = len(plan.stages)
+    ls = plan.assign.get(plan.loss_node)
+    if ls is not None and ls != S - 1:
+        report.add(
+            "HT306", "error",
+            f"collective pipeline expects the loss on the last stage "
+            f"(found on stage {ls})", node=plan.loss_node)
+    for i, st in enumerate(plan.stages):
+        if i == 0 and st.in_nodes:
+            report.add("HT306", "error",
+                       "collective pipeline: stage 0 must not consume "
+                       "boundary tensors")
+        if i > 0 and (len(st.in_nodes) != 1
+                      or plan.assign[st.in_nodes[0]] != i - 1):
+            report.add(
+                "HT306", "error",
+                f"collective pipeline needs a linear chain with one "
+                f"boundary per stage; stage {i} consumes "
+                f"{[(n.name, plan.assign[n]) for n in st.in_nodes]}")
+        if i < S - 1 and len(st.consumed_outs) != 1:
+            report.add(
+                "HT306", "error",
+                f"collective pipeline: stage {i} must export exactly "
+                f"one boundary tensor (got {len(st.consumed_outs)})")
+
+
+def deadlock_pass(eval_nodes, report, schedule="gpipe", nprocs=None,
+                  num_microbatches=None):
+    """Full pass: marker pairing, staging, per-schedule symbolic run."""
+    from ..graph.autodiff import find_topo_sort
+    from ..ops.comm import PipelineReceiveOp, PipelineSendOp
+
+    topo = find_topo_sort([n for n in eval_nodes])
+    unbound = [n for n in topo if isinstance(n, PipelineReceiveOp)
+               and n.bound_send is None]
+    if unbound:
+        pending = PipelineSendOp.pending()
+        if len(pending) != len(unbound):
+            report.add(
+                "HT304", "error",
+                f"unpaired pipeline markers: {len(pending)} pending "
+                f"send(s) vs {len(unbound)} receive(s) — each recv "
+                f"must pair with exactly one send built for this graph",
+                node=unbound[0])
+            return None
+
+    plan = build_plan(eval_nodes, nprocs=nprocs)
+    if plan is None:
+        return None
+    if schedule == "collective":
+        _collective_chain_pass(plan, report)
+        return plan
+    programs = rank_programs(plan, schedule=schedule,
+                             num_microbatches=num_microbatches,
+                             report=report)
+    simulate(programs, report)
+    collective_order_pass(programs, report)
+    return plan

@@ -1,0 +1,249 @@
+"""Codebase self-lint: host impurity inside jit-compiled function bodies.
+
+The bug class PR 5 hit — host-side state (thread-local trace flags,
+wall clocks, ``np.random``) read inside a function that jax traces —
+produces silently wrong programs: the call evaluates ONCE at trace time
+and bakes a constant into the compiled step. This AST checker finds
+function bodies that are statically known to be traced:
+
+* ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` decorated defs,
+* local defs passed to ``jax.jit`` / ``jit`` / ``lax.scan`` /
+  ``jax.vjp`` / ``jax.grad`` / ``jax.value_and_grad`` /
+  ``shard_map`` / ``jax.checkpoint`` (and defs nested inside those),
+
+and flags inside them:
+
+HTP01  wall-clock reads (``time.*``, ``datetime.*``)          error
+HTP02  host RNG (``np.random.*``, ``random.*``)               error
+HTP03  host IO (``open``/``input``/``os.*``)                  error
+HTP10  host ``numpy`` call (fine for static shape math; worth
+       an eye when the operand is traced)                     warn
+HTP20  Python ``if``/``while`` on a traced function parameter
+       (use ``lax.cond`` / ``jnp.where``)                     warn
+
+A line ending in ``# jit-ok`` (optionally with a reason) suppresses its
+findings — for host math that is provably static at trace time.
+
+CLI: ``python -m hetu_tpu.analysis.jit_purity [paths...]`` (default:
+the ``hetu_tpu`` package) — exit 1 when errors exist; wired into CI as
+its own job.
+
+Scope limitation, by design: only *directly* traced bodies are checked.
+A helper called from a jitted function is traced too, but a static
+checker cannot know every call site's context without whole-program
+inference — the direct layer is where PR 5's bug lived.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from .findings import Finding, Report
+
+__all__ = ["check_source", "check_paths", "main"]
+
+_JIT_WRAPPERS = {"jit"}                      # jax.jit(f) / jit(f)
+_TRACED_CALLS = {"jit", "scan", "vjp", "grad", "value_and_grad",
+                 "checkpoint", "shard_map", "eval_shape", "remat"}
+_CLOCK_MODULES = {"time", "datetime"}
+_RNG_ROOTS = {("np", "random"), ("numpy", "random")}
+_HOST_MODULES = {"os"}
+_NUMPY_NAMES = {"np", "numpy"}
+
+
+def _dotted(node):
+    """Attribute/Name chain -> tuple of names ('jax','lax','scan')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_traced_wrapper(call):
+    """Is this Call one whose function argument gets traced?"""
+    chain = _dotted(call.func)
+    if chain is None:
+        return False
+    return chain[-1] in _TRACED_CALLS
+
+
+def _decorated_jit(fn):
+    for dec in fn.decorator_list:
+        chain = _dotted(dec)
+        if chain and chain[-1] in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            dchain = _dotted(dec.func)
+            if dchain and dchain[-1] in _JIT_WRAPPERS:
+                return True
+            if dchain and dchain[-1] == "partial" and dec.args:
+                achain = _dotted(dec.args[0])
+                if achain and achain[-1] in _JIT_WRAPPERS:
+                    return True
+    return False
+
+
+def _collect_traced_defs(tree):
+    """FunctionDefs whose bodies jax traces: decorated ones, plus local
+    defs referenced by name from a traced wrapper call in any scope."""
+    defs_by_scope = {}   # scope node -> {name: FunctionDef}
+
+    class ScopeWalk(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = [tree]
+            defs_by_scope[tree] = {}
+
+        def _visit_fn(self, node):
+            defs_by_scope[self.stack[-1]][node.name] = node
+            self.stack.append(node)
+            defs_by_scope[node] = {}
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_fn
+        visit_AsyncFunctionDef = _visit_fn
+
+    ScopeWalk().visit(tree)
+
+    traced = set()
+    for scope, local in defs_by_scope.items():
+        for fn in local.values():
+            if _decorated_jit(fn):
+                traced.add(fn)
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Call)
+                    and _is_traced_wrapper(node)):
+                continue
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in local:
+                    traced.add(local[arg.id])
+    return traced
+
+
+def _suppressed(src_lines, lineno):
+    if 0 < lineno <= len(src_lines):
+        return "# jit-ok" in src_lines[lineno - 1]
+    return False
+
+
+def _check_body(fn, path, src_lines, report):
+    params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                              + fn.args.kwonlyargs)}
+    params.discard("self")
+
+    def add(code, sev, msg, node):
+        if _suppressed(src_lines, node.lineno):
+            return
+        report.findings.append(Finding(
+            code, sev, msg, where=f"{path}:{node.lineno}",
+            node=fn.name))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if chain:
+                root = chain[0]
+                if root in _CLOCK_MODULES and len(chain) > 1:
+                    add("HTP01", "error",
+                        f"wall-clock read {'.'.join(chain)}() inside "
+                        f"jit-traced {fn.name}() — evaluates once at "
+                        f"trace time, bakes a constant into the "
+                        f"compiled program", node)
+                elif len(chain) >= 2 and chain[:2] in _RNG_ROOTS:
+                    add("HTP02", "error",
+                        f"host RNG {'.'.join(chain)}() inside "
+                        f"jit-traced {fn.name}() — draws once at trace "
+                        f"time; thread the jax PRNG key instead", node)
+                elif root == "random" and len(chain) > 1:
+                    add("HTP02", "error",
+                        f"host RNG {'.'.join(chain)}() inside "
+                        f"jit-traced {fn.name}()", node)
+                elif root in _HOST_MODULES and len(chain) > 1:
+                    add("HTP03", "error",
+                        f"host call {'.'.join(chain)}() inside "
+                        f"jit-traced {fn.name}() — IO/state reads do "
+                        f"not re-execute per step", node)
+                elif chain in (("open",), ("input",)):
+                    add("HTP03", "error",
+                        f"host IO {chain[0]}() inside jit-traced "
+                        f"{fn.name}()", node)
+                elif root in _NUMPY_NAMES and len(chain) > 1:
+                    add("HTP10", "warn",
+                        f"host numpy {'.'.join(chain)}() inside "
+                        f"jit-traced {fn.name}() — fine on static "
+                        f"values; a traced operand silently constant-"
+                        f"folds", node)
+        elif isinstance(node, (ast.If, ast.While)):
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            hit = names & params
+            if hit:
+                add("HTP20", "warn",
+                    f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                    f"on traced parameter(s) {sorted(hit)} inside "
+                    f"jit-traced {fn.name}() — a tracer-dependent "
+                    f"branch raises (or freezes one path); use "
+                    f"lax.cond / jnp.where", node)
+
+
+def check_source(src, path="<string>"):
+    """Lint one module's source; returns a Report."""
+    report = Report()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        report.add("HTP00", "error", f"unparseable module: {e}",
+                   where=path)
+        return report
+    src_lines = src.splitlines()
+    for fn in _collect_traced_defs(tree):
+        _check_body(fn, path, src_lines, report)
+    return report
+
+
+def check_paths(paths):
+    """Lint every ``.py`` under the given files/directories."""
+    report = Report()
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        else:
+            files.append(p)
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            report.extend(check_source(fh.read(), path=f).findings)
+    return report
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.analysis.jit_purity",
+        description="flag host-side impurity inside jit-traced "
+                    "function bodies")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the "
+                             "hetu_tpu package)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+    paths = args.paths or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    report = check_paths(paths)
+    print(report.to_json() if args.json else report.to_text())
+    return 1 if report.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

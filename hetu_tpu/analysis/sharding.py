@@ -1,0 +1,105 @@
+"""Pass 2 — sharding consistency (HT2xx).
+
+Re-runs the planner's ``deduce_states`` fixpoint (the exact propagation
+``parallel/planner.py`` uses to lower DispatchOp markers to
+PartitionSpecs) under the findings collector, so the failure modes that
+today degrade to ``logger.warning`` at trace time become preflight
+findings with node provenance:
+
+HT201  distributed status has no mappable mesh axes (constraint
+       silently dropped at run time — no memory/compute split)   error
+HT202  an op's ``deduce_states`` rule raised (conflicting or
+       malformed input statuses)                                 error
+HT203  implicit reshard: producer and consumer disagree on
+       partition state — XLA inserts collectives here            info
+HT204  plan wants more devices than are attached                 error
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["sharding_pass"]
+
+
+def _bytes_of(shape, itemsize=4):
+    if not shape:
+        return None
+    try:
+        return int(np.prod([int(s) for s in shape])) * itemsize
+    except (TypeError, ValueError):
+        return None
+
+
+def sharding_pass(topo, report, shapes=None, ndevices=None):
+    """Validate the TP plan; returns the node -> NodeStatus dict."""
+    from .findings import collecting
+    from ..ops.comm import DispatchOp, DispatchGradientOp
+    from ..parallel.planner import propagate_statuses, spec_for_status
+    from ..parallel.mesh import factorized_axes
+
+    with collecting(report):
+        status = propagate_statuses(topo)
+    dist = {n: st for n, st in status.items()
+            if st is not None and st.is_dist()}
+    if not dist:
+        return status
+
+    # HT204: the plan must fit the attached device set. Under the
+    # launcher's --preflight subprocess (HETU_PREFLIGHT) the script runs
+    # on the launcher machine whose local devices say nothing about the
+    # fleet's — skip the check rather than falsely reject a valid plan.
+    if ndevices is None and "HETU_PREFLIGHT" not in os.environ:
+        import jax
+        try:
+            ndevices = len(jax.devices())
+        except RuntimeError:
+            ndevices = None
+    if ndevices is not None:
+        for node, st in dist.items():
+            need = st.device_num
+            if need is not None and need > ndevices:
+                report.add(
+                    "HT204", "error",
+                    f"{node.name} wants a {need}-device layout "
+                    f"({st}) but only {ndevices} device(s) are "
+                    f"attached", node=node)
+
+    # HT201: every distributed status must lower to a PartitionSpec over
+    # the mesh the planner would build (spec_for_status emits through
+    # the active collector; outside analysis it keeps its warning)
+    tp_degree = 1
+    for st in dist.values():
+        tp_degree = max(tp_degree,
+                        int(np.prod([s for s in st.state])))
+    model_axes = factorized_axes(tp_degree)
+    with collecting(report):
+        for node, st in dist.items():
+            spec_for_status(st, model_axes, node=node)
+
+    # HT203: edges where the producer's state differs from the
+    # consumer's — an implicit reshard XLA materializes as collectives
+    shapes = shapes or {}
+    for node, st in status.items():
+        if isinstance(node, (DispatchOp, DispatchGradientOp)):
+            continue  # explicit repartition markers: resharding is the point
+        if st is None or st.state is None:
+            continue
+        for inp in node.inputs:
+            sti = status.get(inp)
+            if sti is None or sti.state is None:
+                continue
+            if not (st.is_dist() or sti.is_dist()):
+                continue
+            if sti.state != st.state:
+                nbytes = _bytes_of(shapes.get(inp))
+                est = (f", ~{nbytes / 2 ** 20:.1f} MiB moved per step"
+                       if nbytes else "")
+                report.add(
+                    "HT203", "info",
+                    f"implicit reshard on edge {inp.name} -> "
+                    f"{node.name}: producer state {sti.state} vs "
+                    f"consumer state {st.state}{est} — insert an "
+                    f"explicit dispatch if unintended", node=node)
+    return status

@@ -107,7 +107,8 @@ class HetuConfig:
                  cache_capacity=None, log_path=None, gpipe=False,
                  pipedream=False, dynamic_memory=False, mesh=None,
                  dtype=None, num_microbatches=None, drain_compress=False,
-                 pipeline_mode=None, pp_options=None, telemetry=None):
+                 pipeline_mode=None, pp_options=None, telemetry=None,
+                 validate=None):
         maybe_init_distributed()
         # unified runtime telemetry (span tracer + metrics registry):
         # None resolves to the env-driven process default (enabled when
@@ -148,6 +149,17 @@ class HetuConfig:
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype
         self.ps_comm = None
+        # static preflight verifier (hetu_tpu/analysis): "error" rejects
+        # graphs with findings at construction, "warn" logs them, "off"
+        # (the default) leaves runtime behavior exactly as before
+        if validate is None:
+            validate = os.environ.get("HETU_VALIDATE", "off")
+        if validate not in ("off", "warn", "error"):
+            raise ValueError(
+                f"unknown validate={validate!r}; expected 'off', "
+                "'warn' or 'error'")
+        self.validate = validate
+        self.analysis_report = None
 
         ctx = ctx if ctx is not None else get_current_context()
         ctx = ctx if ctx is not None else _default_ctx()
@@ -205,6 +217,26 @@ class HetuConfig:
             # that leaks into stage traces
             from .parallel.planner import assign_states
             assign_states(eval_node_list, self)
+        # -- static preflight (hetu_tpu/analysis) ------------------------
+        # runs BEFORE the PS client connects / parameters materialize:
+        # HETU_PREFLIGHT (the `heturun --preflight` contract) analyzes,
+        # prints findings, and exits the process — no fleet machinery
+        # ever spins up; Executor(validate=...) analyzes in-process
+        preflight_path = os.environ.get("HETU_PREFLIGHT")
+        if preflight_path is not None or self.validate != "off":
+            from . import analysis
+            report = analysis.analyze(eval_node_list, config=self)
+            self.analysis_report = report
+            if preflight_path is not None:
+                analysis.finish_preflight(report, preflight_path)
+            if self.validate == "error" and report.errors:
+                raise analysis.GraphValidationError(report)
+            if self.validate == "warn":
+                import logging
+                log = logging.getLogger(__name__)
+                for f in report.errors + report.warnings:
+                    log.warning("preflight: %s", f)
+
         if self.comm_mode in ("PS", "Hybrid") or self.ps_nodes:
             from .ps.client import get_default_client
             self.ps_comm = get_default_client()
@@ -425,6 +457,8 @@ class SubExecutor:
         return tuple(key)
 
     def _infer_shapes(self, feed_map):
+        if getattr(self.config, "validate", "off") != "off":
+            self._validate_shapes(feed_map)
         shapes = {}
         from .parallel.distgcn import DistCSR15d
         for node in self.topo_order:
@@ -444,6 +478,42 @@ class SubExecutor:
             node.inferred_shape = shape
             shapes[node] = shape
         return shapes
+
+    def _validate_shapes(self, feed_map):
+        """First-dispatch complement of the construction-time preflight:
+        now that real feed shapes exist, run the analysis shape pass so
+        a mismatch surfaces as a GraphValidationError carrying the
+        *user's* construction line instead of an op assertion deep in
+        ``infer_shape``. Only active under ``Executor(validate=...)``;
+        runs once per new feed-shape key (the compile path)."""
+        from . import analysis
+        from .parallel.distgcn import DistCSR15d
+        feed_shapes = {}
+        for node, v in feed_map.items():
+            if isinstance(v, ndarray.CSRValue):
+                feed_shapes[node] = ((v.nrow, v.ncol), None)
+            elif isinstance(v, DistCSR15d):
+                feed_shapes[node] = ((v.n_nodes, v.n_nodes), None)
+            else:
+                feed_shapes[node] = (tuple(v.shape),
+                                     getattr(v, "dtype", None))
+        report = analysis.Report()
+        analysis.shape_pass(self.topo_order, report,
+                            feed_shapes=feed_shapes)
+        if self.config.analysis_report is not None:
+            # one accumulated report per session: re-compiles for new
+            # feed-shape keys must not duplicate identical findings
+            seen = {(f.code, f.node, f.where, f.message)
+                    for f in self.config.analysis_report.findings}
+            self.config.analysis_report.extend(
+                f for f in report.findings
+                if (f.code, f.node, f.where, f.message) not in seen)
+        if report.errors:
+            if self.config.validate == "error":
+                raise analysis.GraphValidationError(report)
+            import logging
+            for f in report.errors + report.warnings:
+                logging.getLogger(__name__).warning("preflight: %s", f)
 
     def _ensure_state(self, executor):
         """Initialize batchnorm-style op state once shapes are known."""
@@ -554,6 +624,16 @@ class SubExecutor:
             return jitted
         self._last_mem = _memory.capture_compile(
             self.config.telemetry, compiled, label=self.name)
+        if self._last_mem and getattr(self.config, "validate",
+                                      "off") != "off":
+            # exact complement of the static HT402 estimate: the real
+            # XLA memory_analysis numbers vs the HBM budget (HT404)
+            from .analysis.memory import check_compiled
+            import logging
+            for f in check_compiled(self._last_mem):
+                logging.getLogger(__name__).warning("preflight: %s", f)
+                if self.config.analysis_report is not None:
+                    self.config.analysis_report.findings.append(f)
         return compiled
 
     @contextlib.contextmanager

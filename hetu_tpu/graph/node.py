@@ -13,9 +13,50 @@ disappears at run time.
 """
 from __future__ import annotations
 
+import os
+import sys
+
 from ..context import get_current_context, DeviceGroup
 
 G_NODE_ID = 0
+
+# package root for construction-provenance capture: the first stack
+# frame OUTSIDE this directory is the *user's* model line (trailing
+# separator so a sibling like .../hetu_tpu_models.py doesn't match)
+_PKG_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))) + os.sep
+# co_filename is whatever string the module was imported under — a
+# sys.path entry like "tests/../examples/.." leaks into it verbatim, so
+# paths must normalize before the prefix check (memoized: the set of
+# distinct co_filenames on any stack is tiny)
+_NORM_CACHE = {}
+
+
+def _norm(fn):
+    n = _NORM_CACHE.get(fn)
+    if n is None:
+        n = fn if fn.startswith("<") else os.path.normpath(
+            os.path.abspath(fn))
+        _NORM_CACHE[fn] = n
+    return n
+
+
+def _construction_site():
+    """(filename, lineno) of the nearest caller outside hetu_tpu — the
+    user line that built this op. The analysis passes attach it to
+    findings so a shape mismatch ten layers deep reports the model
+    code, not the framework. One cheap frame walk per op; None when
+    construction never left the package (internal graphs)."""
+    try:
+        f = sys._getframe(1)
+    except Exception:       # noqa: BLE001 — provenance is best effort
+        return None
+    while f is not None:
+        fn = _norm(f.f_code.co_filename)
+        if not fn.startswith(_PKG_DIR) and not fn.startswith("<frozen"):
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return None
 
 
 def reset_node_ids():
@@ -81,6 +122,7 @@ class Op:
                         else op_type.__name__)
         self.id = G_NODE_ID
         G_NODE_ID += 1
+        self.defined_at = _construction_site()
         self.name = self.op_type + str(self.id)
         self.desc = self.name + "(" + ", ".join(
             inp.name for inp in self.inputs) + ")"

@@ -15,6 +15,13 @@ assigned one.
 interpolates pale-yellow -> red by cost relative to the most expensive
 op, and the measured ms joins the node's sublabel — the graph view and
 the profiler reading off one artifact.
+
+``findings=`` (an ``analysis.Report``, a list of findings, or a
+``{op_name: severity}`` map) overlays the preflight verifier's
+diagnostics: a node carrying an error gets a thick red border, a warn
+orange, an info blue, and the finding codes join the sublabel and
+tooltip — the graph view and ``Executor(validate=...)`` reading off one
+artifact.
 """
 from __future__ import annotations
 
@@ -38,6 +45,40 @@ def _cost_map(costs):
     out = {}
     for name, ms in items:
         out[str(name)] = out.get(str(name), 0.0) + float(ms)
+    return out
+
+
+_FINDING_STROKE = {"error": "#cc1f1f", "warn": "#e08a00",
+                   "info": "#2b6cb0"}
+_SEV_RANK = {"error": 0, "warn": 1, "info": 2}
+
+
+def _finding_map(findings):
+    """Normalize the ``findings=`` overlay input to
+    ``{op_name: (severity, [codes...], [messages...])}``. Accepts an
+    ``analysis.Report``, an iterable of ``Finding``s, or a plain
+    ``{op_name: severity}`` dict; findings without a node are skipped
+    (they have no box to decorate)."""
+    if not findings:
+        return {}
+    if isinstance(findings, dict):
+        return {str(n): (s, [], []) for n, s in findings.items()}
+    items = getattr(findings, "findings", findings)
+    out = {}
+    for f in items:
+        node = getattr(f, "node", None)
+        if node is None:
+            continue
+        sev = getattr(f, "severity", "warn")
+        code = getattr(f, "code", "")
+        msg = getattr(f, "message", "")
+        cur = out.get(node)
+        if cur is None:
+            out[node] = (sev, [code] if code else [], [msg] if msg else [])
+        else:
+            best = min(cur[0], sev, key=lambda s: _SEV_RANK.get(s, 9))
+            out[node] = (best, cur[1] + ([code] if code else []),
+                         cur[2] + ([msg] if msg else []))
     return out
 
 
@@ -85,13 +126,15 @@ def _annotations(executor, topo):
     return out
 
 
-def to_dot(executor, costs=None):
+def to_dot(executor, costs=None, findings=None):
     """Graphviz source for the session graph (reference
     graph2fig.py:11-23 builds the same node/edge list); ``costs``
-    overlays cost heat exactly like ``render``."""
+    overlays cost heat and ``findings`` the preflight diagnostics
+    exactly like ``render``."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap = _cost_map(costs)
+    fmap = _finding_map(findings)
     max_cost = max(cmap.values()) if cmap else 0.0
     lines = ["digraph hetu {", "  rankdir=TB;",
              '  node [shape=box, fontsize=10];']
@@ -110,8 +153,16 @@ def to_dot(executor, costs=None):
             color = _STAGE_COLORS[stage % len(_STAGE_COLORS)]
         else:
             color = "#eeeeee"
+        extra = ""
+        hit = fmap.get(node.name)
+        if hit is not None:
+            sev, codes, _msgs = hit
+            if codes:
+                label += "\\n" + " ".join(dict.fromkeys(codes))
+            stroke = _FINDING_STROKE.get(sev, _FINDING_STROKE["info"])
+            extra = f', color="{stroke}", penwidth=2.4'
         lines.append(f'  n{node.id} [label="{label}", style=filled, '
-                     f'fillcolor="{color}"];')
+                     f'fillcolor="{color}"{extra}];')
     for node in topo:
         for inp in node.inputs:
             lines.append(f"  n{inp.id} -> n{node.id};")
@@ -149,13 +200,16 @@ def _layout(topo):
     return coords, order
 
 
-def render(executor, path="graphboard.html", costs=None):
+def render(executor, path="graphboard.html", costs=None, findings=None):
     """Write a standalone HTML/SVG of the graph (plus .dot beside it);
     returns the html path. ``costs`` (``profile_ops`` output or a
-    {name: ms} dict) switches node fill to per-op cost heat."""
+    {name: ms} dict) switches node fill to per-op cost heat;
+    ``findings`` (an ``analysis.Report``) marks diagnosed nodes with a
+    severity-colored border and their HT codes."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap = _cost_map(costs)
+    fmap = _finding_map(findings)
     max_cost = max(cmap.values()) if cmap else 0.0
     coords, order = _layout(topo)
 
@@ -197,14 +251,26 @@ def render(executor, path="graphboard.html", costs=None):
         title = html.escape(getattr(node, "desc", node.name))
         if cost is not None:
             title += html.escape(f" — {cost:.3f} ms")
+        hit = fmap.get(node.name)
+        stroke, swidth, codes_txt = "#888", 1, None
+        if hit is not None:
+            sev, codes, msgs = hit
+            stroke = _FINDING_STROKE.get(sev, _FINDING_STROKE["info"])
+            swidth = 2.5
+            if codes:
+                codes_txt = " ".join(dict.fromkeys(codes))
+            for m in msgs[:3]:
+                title += html.escape(f"\n{m}")
         sub = " / ".join(x for x in (
+            codes_txt,
             f"stage {stage}" if stage is not None else None,
             spec,
             f"{cost:.2f} ms" if cost is not None else None) if x)
         parts.append(
             f'<g><title>{title}</title>'
             f'<rect x="{px}" y="{py}" width="{bw}" height="{bh}" '
-            f'rx="5" fill="{fill}" stroke="#888"/>'
+            f'rx="5" fill="{fill}" stroke="{stroke}" '
+            f'stroke-width="{swidth}"/>'
             f'<text x="{px + bw / 2:.0f}" y="{py + 18}" '
             f'text-anchor="middle">{html.escape(node.name[:22])}</text>'
             + (f'<text x="{px + bw / 2:.0f}" y="{py + 34}" '
@@ -220,15 +286,19 @@ def render(executor, path="graphboard.html", costs=None):
     with open(path, "w") as f:
         f.write(page)
     with open(os.path.splitext(path)[0] + ".dot", "w") as f:
-        f.write(to_dot(executor, costs=costs))
+        f.write(to_dot(executor, costs=costs, findings=findings))
     return path
 
 
-def show(executor, path="graphboard.html", port=None, costs=None):
+def show(executor, path="graphboard.html", port=None, costs=None,
+         findings=None):
     """Render and (optionally) serve like the reference's graphboard
     (graph2fig.py:11-33). ``port=None`` skips the server; ``costs``
-    (``profile_ops`` output) overlays per-op cost heat coloring."""
-    out = render(executor, path, costs=costs)
+    (``profile_ops`` output) overlays per-op cost heat coloring;
+    ``findings`` (an ``analysis.Report``, e.g.
+    ``executor.config.analysis_report``) overlays preflight
+    diagnostics."""
+    out = render(executor, path, costs=costs, findings=findings)
     if port is None:
         return out
     import functools

@@ -219,6 +219,49 @@ def _worker_env(cfg, base_env, rank, coordinator=None):
     return env
 
 
+def run_preflight(cfg, command):
+    """Static preflight gate (``heturun --preflight``): run ``command``
+    ONCE in a plain subprocess with ``HETU_PREFLIGHT`` set. The
+    executor's config hook (executor.py) analyzes the graph the script
+    builds, prints findings, and exits before any PS/worker machinery —
+    no fleet env (coordinator, PS hosts) is exported, so a multi-host
+    script preflights entirely on the launcher machine. Only the stage-
+    ownership env (HETU_NUM_PROCS / HETU_HOSTS) is provided, so the
+    deadlock pass maps stage hostnames to the ranks the real launch
+    would use. Returns the subprocess's exit code: 0 = clean graph,
+    analysis.EXIT_PREFLIGHT = findings rejected it, anything else = the
+    script crashed before the verifier ran (equally a reason not to
+    spawn the fleet)."""
+    import tempfile
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hosts_in_order = []
+    for host, n in cfg.worker_hosts():
+        hosts_in_order.extend([host] * n)
+    report_path = os.path.join(tempfile.mkdtemp(prefix="hetu-preflight-"),
+                               "preflight.json")
+    env = {**os.environ,
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "HETU_PREFLIGHT": report_path,
+           "HETU_NUM_PROCS": str(max(1, cfg.num_workers))}
+    if hosts_in_order:
+        env["HETU_HOSTS"] = ",".join(hosts_in_order)
+    for stale in ("HETU_COORDINATOR", "HETU_PS_HOSTS", "HETU_PS_PORTS",
+                  "HETU_PROC_ID"):
+        env.pop(stale, None)
+    p = subprocess.run(command, env=env)
+    if p.returncode == 0:
+        if os.path.exists(report_path):
+            print(f"preflight: graph verified clean "
+                  f"(report: {report_path})")
+        else:
+            # exit 0 without a report = the script finished without ever
+            # constructing an Executor — nothing was actually verified
+            print("preflight: WARNING script exited 0 but never built a "
+                  "graph (no Executor constructed); nothing was verified")
+    return p.returncode
+
+
 def launch_command(cfg, command, identify=None, telemetry=None,
                    hang_timeout=None):
     """Run ``command`` once per worker with the cluster env wired
@@ -466,6 +509,13 @@ def main(argv=None):
                              "under DIR, merged into one Perfetto "
                              "trace at exit; PS servers serve "
                              "Prometheus /metrics")
+    parser.add_argument("--preflight", action="store_true",
+                        help="static graph verification only: run the "
+                             "command once on this machine with the "
+                             "hetu_tpu.analysis passes armed, print "
+                             "findings, and exit WITHOUT spawning "
+                             "PS servers or workers (exit 0 clean, "
+                             "121 on errors)")
     parser.add_argument("--hang-timeout", type=float, default=None,
                         metavar="SECONDS",
                         help="arm the fleet watchdog: when any rank's "
@@ -483,6 +533,8 @@ def main(argv=None):
           f"servers({cfg.num_servers})={cfg.servers} "
           f"workers({cfg.num_workers})={cfg.workers}")
     signal.signal(signal.SIGINT, _shutdown)
+    if args.preflight:
+        return run_preflight(cfg, args.command)
     return launch_command(cfg, args.command, args.identify,
                           telemetry=args.telemetry,
                           hang_timeout=args.hang_timeout)

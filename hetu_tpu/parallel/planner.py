@@ -62,13 +62,19 @@ def spec_for_status(status, model_axes, node=None):
             cand = next((n for n, s in avail.items()
                          if s == p and n not in take), None)
             if cand is None:
-                logger.warning(
-                    "TP constraint dropped: %s wants status %s but the "
-                    "%d-way split has no free mesh axis of size %d in "
-                    "%s — the node runs unconstrained (replicated "
-                    "layout, no memory/compute split)",
-                    node if node is not None else "<node>", status,
-                    parts, p, dict(model_axes))
+                # under an active analysis pass this is a structured
+                # HT201 finding with node provenance; the bare warning
+                # stays as the fallback when analysis is off
+                from ..analysis.findings import emit
+                msg = (f"TP constraint unmappable: "
+                       f"{node if node is not None else '<node>'} "
+                       f"wants status {status} but the {parts}-way "
+                       f"split has no free mesh axis of size {p} in "
+                       f"{dict(model_axes)} — the node would run "
+                       f"unconstrained (replicated layout, no "
+                       f"memory/compute split)")
+                if not emit("HT201", "error", msg, node=node):
+                    logger.warning("%s", msg)
                 return None
             take.append(cand)
         del_names = list(take)
@@ -123,10 +129,15 @@ def propagate_statuses(topo, sweeps=3):
                      for s in in_sts], st, False)
             except Exception as e:
                 # the node stays unconstrained (numerics unaffected — XLA
-                # picks a layout) but a broken rule must not be silent
-                logger.warning(
-                    "deduce_states failed for %s (%s: %s); leaving the "
-                    "node unconstrained", node, type(e).__name__, e)
+                # picks a layout) but a broken rule must not be silent:
+                # structured HT202 under an analysis pass, warning else
+                from ..analysis.findings import emit
+                msg = (f"deduce_states failed for {node} "
+                       f"({type(e).__name__}: {e}) — conflicting or "
+                       f"malformed input partition statuses; the node "
+                       f"runs unconstrained")
+                if not emit("HT202", "error", msg, node=node):
+                    logger.warning("%s", msg)
                 continue
             if st.state is None:
                 continue

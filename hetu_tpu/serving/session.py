@@ -18,12 +18,9 @@ import time
 import numpy as np
 
 from .. import telemetry as _telemetry
-from ..dataloader import DataloaderOp, GNNDataLoaderOp
 from ..executor import Executor, HetuConfig
 from ..graph.autodiff import find_topo_sort
 from ..graph.node import Op
-from ..optimizer import OptimizerOp
-from ..ops.comm import ParameterServerCommunicateOp
 
 __all__ = ["InferenceSession", "next_bucket"]
 
@@ -118,21 +115,14 @@ class InferenceSession:
 
     @staticmethod
     def _check_frozen(eval_node_list):
-        for n in find_topo_sort(eval_node_list):
-            if isinstance(n, OptimizerOp):
-                raise ValueError(
-                    "InferenceSession over a training graph: eval nodes "
-                    "reach an OptimizerOp — pass the model outputs only "
-                    "(no train_op)")
-            if isinstance(n, ParameterServerCommunicateOp):
-                raise ValueError(
-                    "InferenceSession graph contains a PS push op "
-                    "(ParameterServerCommunicate) — serving sessions "
-                    "never push gradients")
-            if isinstance(n, (DataloaderOp, GNNDataLoaderOp)):
-                raise ValueError(
-                    "InferenceSession graphs are feed-driven; replace "
-                    "dataloader ops with placeholder feeds")
+        # the frozen-graph contract (no optimizer / PS push / dataloader
+        # ops) is an analysis pass (HT15x findings); construction keeps
+        # raising ValueError so the session API is unchanged
+        from ..analysis import Report, frozen_graph_pass
+        report = Report()
+        frozen_graph_pass(find_topo_sort(eval_node_list), report)
+        if report.errors:
+            raise ValueError("\n".join(f.message for f in report.errors))
 
     # ------------------------------------------------------------------
     def load(self, checkpoint):

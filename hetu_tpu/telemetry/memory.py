@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 
 __all__ = ["capture_compile", "observe_device_memory", "oom_report",
-           "is_oom", "device_memory_stats"]
+           "is_oom", "device_memory_stats", "fmt_bytes"]
 
 _MEM_FIELDS = (
     ("argument_size_in_bytes", "arg_bytes"),
@@ -108,12 +108,16 @@ def is_oom(exc):
     return "RESOURCE_EXHAUSTED" in repr(exc) or "Out of memory" in repr(exc)
 
 
-def _fmt_bytes(n):
+def fmt_bytes(n):
+    """Human-readable byte count (shared with the analysis passes)."""
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
             return (f"{n:.1f}{unit}" if unit != "B" else f"{n}{unit}")
         n /= 1024.0
     return f"{n}B"
+
+
+_fmt_bytes = fmt_bytes      # internal callers predate the public name
 
 
 def oom_report(named_params=None, limit=20, out_dir=None, rank=0):

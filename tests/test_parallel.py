@@ -132,8 +132,8 @@ def test_spec_lowering_warns_on_unmappable(caplog):
                          logger="hetu_tpu.parallel.planner"):
         assert spec_for_status(st, axes, node="MatMulOp(w_proj)") is None
     msgs = [r.getMessage() for r in caplog.records]
-    assert any("MatMulOp(w_proj)" in m and "dropped" in m for m in msgs), \
-        msgs
+    assert any("MatMulOp(w_proj)" in m and "unmappable" in m
+               for m in msgs), msgs
 
 
 def test_dp_loss_equivalence():
