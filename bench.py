@@ -128,15 +128,25 @@ def mfu_fields(flops_per_step, sec_per_step):
 # link and the step distribution looked like when it was taken)
 _ATTRIBUTION_FIELDS = ("h2d_MBps", "step_ms_p50", "step_ms_p95")
 
+# feed-bound units additionally prove the host-overlap claim in the
+# artifact (BENCH_r07 acceptance): ingest_wait_ms (p50 device-waited-
+# on-host, ~0 when hidden) + overlap_fraction (share of ingest host
+# time riding under compute) from Executor.ingest_stats()
+_OVERLAP_FIELDS = ("ingest_wait_ms", "overlap_fraction")
+_FEED_BOUND_METRICS = ("wdl_criteo_ps", "wdl_criteo_hybrid", "ncf_ml25m")
+
 
 def emit(metric, value, unit, vs, **extra):
     if unit != "error":
         missing = [k for k in _ATTRIBUTION_FIELDS if k not in extra]
+        if metric.startswith(_FEED_BOUND_METRICS):
+            missing += [k for k in _OVERLAP_FIELDS if k not in extra]
         if missing:
             raise ValueError(
                 f"bench metric {metric!r} emitted without attribution "
                 f"fields {missing}; every metric must carry h2d_MBps "
-                f"and p50/p95 step time (add them, don't drop them)")
+                f"and p50/p95 step time, and feed-bound units the "
+                f"ingest overlap accounting (add them, don't drop them)")
     rec = {"metric": metric, "value": round(float(value), 1),
            "unit": unit, "vs_baseline": round(float(vs), 3)}
     for k, v in extra.items():
@@ -384,6 +394,7 @@ def bench_wdl_ps():
         steps = 300
         windows = 4
         sps_all = []
+        exe.reset_ingest_stats()     # exclude warmup from the accounting
         for _ in range(windows):
             t0 = time.perf_counter()
             out = exe.run_batches_stream(
@@ -391,6 +402,7 @@ def bench_wdl_ps():
             out[-1][0].asnumpy()
             dt = time.perf_counter() - t0
             sps_all.append(steps * batch / dt)
+        overlap_fields = exe.ingest_stats()
         times = exe.ps_runtime.phase_breakdown()
         perf = times.pop("cache_perf", {})
         breakdown = {k: round(v * 1000 / (steps * windows), 3)
@@ -408,8 +420,12 @@ def bench_wdl_ps():
              best=float(max(sps_all)), workers=1, servers=1,
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
              jit_compiles=_compiles() - c0,
+             lookahead=exe.config.overlap.lookahead,
+             bucket_bytes=exe.config.overlap.bucket_bytes,
+             **overlap_fields,
              **_pctl([b / kblock for b in blocks]),
-             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
+             note="async-ingest streamed: next block's feed H2D rides "
+                  "under the current block's compute (ingest.py)")
         exe.close()     # drain before the finally block kills the server
     finally:
         client.shutdown_servers()
@@ -465,17 +481,23 @@ def bench_wdl_ps_host():
             for i in range(10):                  # warm + compile
                 out = exe.run(feed_dict=feed(i))
             out[0].asnumpy()
-            # host path dispatches per step (no scan block) — every
-            # pull/push is on the critical path by design
-            steps, windows = 60, 3
+            # host path still dispatches per step (no scan block), but
+            # the stream pipelines it: step i+1's SparsePull + feed
+            # device_put run on the ingest worker while step i's
+            # dispatched compute is in flight (PSRuntime.
+            # run_stream_pipelined) — the pull leaves the critical path
+            steps, windows, kblock = 60, 3, 20
             sps_all = []
+            exe.reset_ingest_stats()
             for _ in range(windows):
                 t0 = time.perf_counter()
-                for i in range(steps):
-                    out = exe.run(feed_dict=feed(i))
-                out[0].asnumpy()
+                out = exe.run_batches_stream(
+                    [feed(i0 + j) for j in range(kblock)]
+                    for i0 in range(0, steps, kblock))
+                out[-1][0].asnumpy()
                 sps_all.append(steps * batch
                                / (time.perf_counter() - t0))
+            overlap_fields = exe.ingest_stats()
             samples = _step_samples(
                 lambda: exe.run(feed_dict=feed(0)),
                 lambda out: out[0].asnumpy(), 8)
@@ -485,10 +507,13 @@ def bench_wdl_ps_host():
                  best=float(max(sps_all)), workers=1, servers=1,
                  h2d_MBps=h2d_probe_mbps(),
                  bytes_per_step=bytes_per_step,
-                 jit_compiles=_compiles() - c0, **_pctl(samples),
-                 note="host path: per-step SparsePull/Push on the "
-                      "critical path; compare wdl_criteo_ps for the "
-                      "device-cache speedup")
+                 jit_compiles=_compiles() - c0,
+                 lookahead=exe.config.overlap.lookahead,
+                 bucket_bytes=exe.config.overlap.bucket_bytes,
+                 **overlap_fields, **_pctl(samples),
+                 note="host path, pipelined: next step's SparsePull + "
+                      "feed H2D overlap the in-flight compute; compare "
+                      "wdl_criteo_ps for the device-cache speedup")
             exe.close()
         finally:
             client.shutdown_servers()
@@ -542,12 +567,14 @@ def bench_wdl_hybrid():
         out[-1][0].asnumpy()
         steps = 300
         sps_all = []
+        exe.reset_ingest_stats()
         for _ in range(3):
             t0 = time.perf_counter()
-            for i0 in range(0, steps, kblock):
-                out = exe.run_batches(block(i0))
+            out = exe.run_batches_stream(
+                block(i0) for i0 in range(0, steps, kblock))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
+        overlap_fields = exe.ingest_stats()
         blocks = _step_samples(lambda: exe.run_batches(block(0)),
                                lambda out: out[-1][0].asnumpy(), 3)
         emit("wdl_criteo_hybrid_samples_per_sec_per_chip",
@@ -556,8 +583,12 @@ def bench_wdl_hybrid():
              best=float(max(sps_all)), workers=1, servers=1,
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
              jit_compiles=_compiles() - c0,
+             lookahead=exe.config.overlap.lookahead,
+             bucket_bytes=exe.config.overlap.bucket_bytes,
+             **overlap_fields,
              **_pctl([b / kblock for b in blocks]),
-             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
+             note="async-ingest streamed: next block's feed H2D rides "
+                  "under the current block's compute (ingest.py)")
         exe.close()
     finally:
         client.shutdown_servers()
@@ -617,12 +648,14 @@ def bench_ncf():
         out[-1][0].asnumpy()
         steps = 300
         sps_all = []
+        exe.reset_ingest_stats()
         for _ in range(3):
             t0 = time.perf_counter()
-            for i0 in range(0, steps, kblock):
-                out = exe.run_batches(block(i0))
+            out = exe.run_batches_stream(
+                block(i0) for i0 in range(0, steps, kblock))
             out[-1][0].asnumpy()
             sps_all.append(steps * batch / (time.perf_counter() - t0))
+        overlap_fields = exe.ingest_stats()
         blocks = _step_samples(lambda: exe.run_batches(block(0)),
                                lambda out: out[-1][0].asnumpy(), 3)
         emit("ncf_ml25m_hybrid_samples_per_sec_per_chip",
@@ -631,8 +664,11 @@ def bench_ncf():
              best=float(max(sps_all)),
              h2d_MBps=h2d_probe_mbps(), bytes_per_step=bytes_per_step,
              jit_compiles=_compiles() - c0,
+             lookahead=exe.config.overlap.lookahead,
+             **overlap_fields,
              **_pctl([b / kblock for b in blocks]),
-             note="feed-transfer-bound: tunnel H2D swings >2x run-to-run")
+             note="async-ingest streamed: next block's feed H2D rides "
+                  "under the current block's compute (ingest.py)")
         exe.close()
     finally:
         client.shutdown_servers()

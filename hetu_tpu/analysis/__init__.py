@@ -18,7 +18,11 @@ provenance:
    symbolically rank-by-rank; unmatched sends/recvs and cyclic waits
    become findings instead of fleet hangs,
 4. **memory** (HT4xx) — static footprint estimate (and, at compile
-   time, ``memory_analysis()`` numbers) against an HBM budget.
+   time, ``memory_analysis()`` numbers) against an HBM budget,
+5. **overlap** (HT5xx, advisory) — feed-bound (PS-backed) configs that
+   run with the async ingest engine off, or through plain per-step
+   ``run()`` loops that never engage it (runtime half in
+   ``executor.py``).
 
 Surfaces: ``Executor(validate="error"|"warn"|"off")``,
 ``heturun --preflight``, ``python -m hetu_tpu.analysis`` (zoo CLI),
@@ -36,11 +40,13 @@ from .shapes import shape_pass, lint_pass, frozen_graph_pass
 from .sharding import sharding_pass
 from .deadlock import deadlock_pass
 from .memory import memory_pass, check_compiled
+from .overlap import overlap_pass, RunLoopAdvisor
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
            "emit", "provenance", "analyze", "finish_preflight",
            "shape_pass", "lint_pass", "frozen_graph_pass",
            "sharding_pass", "deadlock_pass", "memory_pass",
+           "overlap_pass", "RunLoopAdvisor",
            "check_compiled", "EXIT_PREFLIGHT"]
 
 # distinct exit code for "preflight found errors" (cf. the watchdog's
@@ -98,6 +104,7 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
            num_microbatches=num_microbatches)
     _guard("memory", memory_pass, topo, shapes, report,
            budget=hbm_budget)
+    _guard("overlap", overlap_pass, topo, report, config=config)
     if frozen:
         _guard("frozen", frozen_graph_pass, topo, report)
     return report

@@ -71,6 +71,12 @@ class Dataloader:
         self.last_batch_size = batch.shape[0]
         return batch
 
+    def get_arrs(self, n):
+        """The next ``n`` batches in order (the executor's prefetch-ring
+        refill, generalized to ``overlap_options['lookahead']`` depth);
+        ``n <= 0`` returns []."""
+        return [self.get_arr() for _ in range(max(0, int(n)))]
+
     def get_next_arr(self):
         if not self.inited:
             self.init_states()
@@ -107,6 +113,9 @@ class DataloaderOp(Op):
 
     def get_arr(self, name):
         return self._dl(name).get_arr()
+
+    def get_arrs(self, name, n):
+        return self._dl(name).get_arrs(n)
 
     def get_next_arr(self, name):
         return self._dl(name).get_next_arr()
@@ -153,6 +162,11 @@ class GNNDataLoaderOp(Op):
 
     def get_arr(self, name):
         return self.handler(self.graph)
+
+    def get_arrs(self, name, n):
+        # the double-buffer contract forbids reading ahead; the
+        # executor never asks for more than the current graph here
+        return [self.get_arr(name) for _ in range(max(0, int(n)))]
 
     def get_next_arr(self, name):
         return self.handler(self.nxt_graph)

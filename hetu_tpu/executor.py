@@ -25,12 +25,14 @@ import contextlib
 import os
 import pickle
 import time
+from collections import deque
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from . import ingest as _ingest_engine
 from . import ndarray
 from . import telemetry as _telemetry
 from .telemetry import memory as _memory
@@ -108,7 +110,7 @@ class HetuConfig:
                  pipedream=False, dynamic_memory=False, mesh=None,
                  dtype=None, num_microbatches=None, drain_compress=False,
                  pipeline_mode=None, pp_options=None, telemetry=None,
-                 validate=None):
+                 validate=None, overlap_options=None):
         maybe_init_distributed()
         # unified runtime telemetry (span tracer + metrics registry):
         # None resolves to the env-driven process default (enabled when
@@ -145,6 +147,11 @@ class HetuConfig:
         # unroll_fill_drain / boundary_dtype), forwarded verbatim to
         # CollectiveGPipe — see parallel/collective_pp.py
         self.pp_options = pp_options
+        # host-overlap knobs: async ingest engine on/off + lookahead
+        # depth, and gradient-allreduce bucketing (hetu_tpu/ingest.py;
+        # defaults preserve pre-existing behavior everywhere)
+        self.overlap = _ingest_engine.OverlapOptions.resolve(
+            overlap_options)
         self.num_microbatches = num_microbatches
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype
@@ -540,6 +547,26 @@ class SubExecutor:
         optimizer_set = set(self.optimizer_ops)
         ps_ops = list(self.ps_ops)
         host_ops = set(ps_ops)      # sparse-pull ops arrive as feeds
+        # bucketed gradient allreduce (overlap_options["bucket_bytes"]):
+        # optimizer-consumed AllReduce comm ops skip their per-grad
+        # collective; the OptimizerOp reduces them in size-targeted
+        # buckets instead (ops/comm.py bucketed_allreduce). Only comm
+        # ops whose sole consumer is the optimizer are deferred — the
+        # set is computed here, at trace-build time.
+        allreduce_defer = frozenset()
+        if getattr(config, "overlap", None) is not None and \
+                config.overlap.bucket_bytes:
+            consumers = {}
+            for op in topo:
+                for inp in op.inputs:
+                    consumers.setdefault(inp, []).append(op)
+            eval_set = set(eval_nodes)
+            allreduce_defer = frozenset(
+                inp for op in self.optimizer_ops for inp in op.inputs
+                if isinstance(inp, AllReduceCommunicateOp)
+                and inp not in eval_set
+                and all(c in optimizer_set
+                        for c in consumers.get(inp, ())))
 
         def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
             # per-step key folded INSIDE the jit: an eager fold_in per
@@ -547,6 +574,8 @@ class SubExecutor:
             rng = jax.random.fold_in(rng, step_idx)
             ectx = ExecContext(training=training, base_rng=rng,
                                config=config)
+            if allreduce_defer:
+                ectx.allreduce_defer = allreduce_defer
             ectx.params = {n: params[str(n.id)] for n in param_order}
             if config.dtype is not None:
                 # mixed precision: fwd/bwd in config.dtype (bf16 on the
@@ -688,20 +717,44 @@ class SubExecutor:
         donate = (0, 1, 2) if self.training else ()
         return jax.jit(block_fn, donate_argnums=donate)
 
+    def ingest_feeds(self, feed_dicts, dl_host=None):
+        """Stack + device-transfer a block's plain feeds (and, when the
+        caller fetched them in order, its dataloader batches) — the
+        stateless half of ``run_block``'s host phase, safe to run on
+        the async ingest worker while the previous block executes.
+        Returns the ``{node: (stacked, first_row)}`` map ``run_block``
+        accepts as ``pre_ingested``."""
+        out = {}
+        for node in (feed_dicts[0] or {}):
+            out[node] = self._stack_feed([fd[node] for fd in feed_dicts])
+        for dl, arrs in (dl_host or {}).items():
+            stacked = np.stack(arrs)
+            out[dl] = (self._ingest_stacked(stacked), stacked[0])
+        return out
+
     def run_block(self, executor, feed_dicts,
-                  convert_to_numpy_ret_vals=False):
+                  convert_to_numpy_ret_vals=False, pre_ingested=None):
         """Run ``len(feed_dicts)`` steps in one dispatch (host-feed path;
         the PS runtime has its own block path). Returns per-step results:
-        a list of output lists."""
+        a list of output lists. ``pre_ingested`` (from ``ingest_feeds``,
+        possibly on the async ingest worker) skips the in-line feed
+        stacking — the double-buffered input path."""
         assert not (self.ps_ops or self.ps_lookups or self.ps_pull_ops), \
             "PS graphs run blocks through the PS runtime"
         nsteps = len(feed_dicts)
         feed_map = {}      # node -> stacked device value
         first_map = {}     # node -> step-0 value (shape inference)
+        for node, (stacked, first) in (pre_ingested or {}).items():
+            feed_map[node] = stacked
+            first_map[node] = first
         for node in (feed_dicts[0] or {}):
+            if node in feed_map:
+                continue
             feed_map[node], first_map[node] = self._stack_feed(
                 [fd[node] for fd in feed_dicts])
         for dl in self.dataloader_ops:
+            if dl in feed_map:
+                continue
             stacked = np.stack(self.dl_block(dl, nsteps))
             feed_map[dl] = self._ingest_stacked(stacked)
             first_map[dl] = stacked[0]
@@ -786,7 +839,8 @@ class SubExecutor:
         tel = self.config.telemetry
         if tel.enabled and not isinstance(arr, jax.Array):
             tel.inc("h2d_bytes", int(arr.nbytes))
-            tel.instant("h2d_stacked", bytes=int(arr.nbytes))
+            tel.instant("h2d_stacked", bytes=int(arr.nbytes),
+                        overlapped=_ingest_engine.on_worker())
         sharding = self.config.data_sharding(arr.ndim)
         if sharding is not None and arr.ndim >= 2 and \
                 arr.shape[1] % self.config.nrank == 0:
@@ -863,8 +917,9 @@ class SubExecutor:
 
     def next_dl_batch(self, dl):
         """(host, device) batch for this step, with the FOLLOWING
-        batch's h2d transfer already issued — the reference dataloader's
-        prefetch ring (dataloader.py:26-81): the next batch's DMA
+        ``overlap.lookahead`` batches' h2d transfers already issued —
+        the reference dataloader's prefetch ring (dataloader.py:26-81)
+        generalized to a configurable depth: the staged batches' DMA
         overlaps this step's compute instead of starting at the next
         step's dispatch.
 
@@ -877,25 +932,32 @@ class SubExecutor:
         staged = getattr(self, "_dl_staged", None)
         if staged is None:
             staged = self._dl_staged = {}
-        cur = staged.get(dl)
-        if cur is None:
+        q = staged.get(dl)
+        if q is None:
+            q = staged[dl] = deque()
+        if not q:
             value = dl.get_arr(self.name)
-            cur = (value, self._ingest(value))
-        nxt = dl.get_arr(self.name)
-        staged[dl] = (nxt, self._ingest(nxt))
+            q.append((value, self._ingest(value)))
+        cur = q.popleft()
+        overlap = getattr(self.config, "overlap", None)
+        # ingest off restores the pre-existing 1-deep ring exactly
+        depth = overlap.lookahead \
+            if overlap is not None and overlap.ingest else 1
+        for arr in dl.get_arrs(self.name, depth - len(q)):
+            q.append((arr, self._ingest(arr)))
         return cur
 
     def dl_block(self, dl, nsteps):
-        """``nsteps`` host batches in order, honoring any batch the
+        """``nsteps`` host batches in order, honoring batches the
         prefetch ring already staged from an interleaved run() call
-        (the staged device copy is dropped — a one-transfer cost at the
-        run() -> run_batches() transition only)."""
+        (the staged device copies are dropped — a one-transfer cost at
+        the run() -> run_batches() transition only)."""
         out = []
-        staged = getattr(self, "_dl_staged", {}).pop(dl, None)
-        if staged is not None:
-            out.append(staged[0])
-        while len(out) < nsteps:
-            out.append(dl.get_arr(self.name))
+        q = getattr(self, "_dl_staged", {}).get(dl)
+        while q and len(out) < nsteps:
+            out.append(q.popleft()[0])
+        if len(out) < nsteps:
+            out.extend(dl.get_arrs(self.name, nsteps - len(out)))
         return out
 
     def _ingest(self, value):
@@ -916,8 +978,11 @@ class SubExecutor:
         if tel.enabled and not isinstance(arr, jax.Array):
             # h2d attribution: bytes on the span + running counter (the
             # transfer itself is async — the span times the dispatch,
-            # the byte counter is what MB/s accounting needs)
-            with tel.span("h2d_transfer", bytes=int(arr.nbytes)):
+            # the byte counter is what MB/s accounting needs); the
+            # `overlapped` attr marks transfers issued by the async
+            # ingest worker, i.e. riding under compute in the trace
+            with tel.span("h2d_transfer", bytes=int(arr.nbytes),
+                          overlapped=_ingest_engine.on_worker()):
                 out = jax.device_put(arr, sharding)
             tel.inc("h2d_bytes", int(arr.nbytes))
             return out
@@ -1026,12 +1091,40 @@ class Executor:
         # `is None` check
         self._heartbeat = _watchdog.heartbeat_from_env()
 
+        # -- async-ingest accounting (hetu_tpu/ingest.py) --------------
+        # every engine this session runs folds its wait/busy numbers in
+        # here, so bench/metric code can report ingest_wait_ms and
+        # overlap_fraction per measurement window (reset + read)
+        self._ingest_stats = _ingest_engine.new_stats()
+
+        # -- HT502 run-loop advisory (analysis/overlap.py) -------------
+        # PS-backed sessions driven by long plain run() loops never
+        # reach the ingest engine; advise run_batches_stream once.
+        # None on non-PS graphs — the per-step cost is one `is None`
+        self._run_loop_advisor = None
+        if self.ps_runtime is not None:
+            from .analysis.overlap import RunLoopAdvisor
+            self._run_loop_advisor = RunLoopAdvisor(self.config)
+
     @property
     def base_rng(self):
         return self._base_rng
 
     def rngkey(self, step):
         return jax.random.fold_in(self._base_rng, step)
+
+    # ------------------------------------------------------------------
+    def ingest_stats(self):
+        """Async-ingest accounting since the last reset:
+        ``ingest_wait_ms`` (p50 of per-pop consumer stalls — ~0 when
+        the host is fully hidden), wait/busy sums, and
+        ``overlap_fraction`` (share of ingest host time hidden behind
+        the device). See hetu_tpu/ingest.py."""
+        return _ingest_engine.stats_fields(self._ingest_stats)
+
+    def reset_ingest_stats(self):
+        """Zero the ingest accounting (bench: exclude warmup windows)."""
+        self._ingest_stats = _ingest_engine.new_stats()
 
     # ------------------------------------------------------------------
     def run(self, name="default", eval_node_list=None, feed_dict=None,
@@ -1045,6 +1138,8 @@ class Executor:
         if self.step_logger is not None:
             self.step_logger.begin()
         sub = self.subexecutors[name]
+        if self._run_loop_advisor is not None:
+            self._run_loop_advisor.on_run_step()
         tel = self.config.telemetry
         try:
             if tel.enabled:
@@ -1102,6 +1197,8 @@ class Executor:
                 "dispatch over microbatches; call run() per step")
         needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
                     or sub.cached_lookups)
+        if self._run_loop_advisor is not None:
+            self._run_loop_advisor.on_stream()
         try:
             if needs_ps:
                 out = self.ps_runtime.run_block(
@@ -1121,24 +1218,36 @@ class Executor:
         return out
 
     def run_batches_stream(self, blocks, name="default",
-                           convert_to_numpy_ret_vals=False, lookahead=2):
-        """run_batches over an iterable of blocks with BUFFERED feeds:
-        while block i executes on device, a lookahead thread stacks and
-        device-transfers the next ``lookahead`` blocks' plain feeds
-        (the stateless half of the host phase — cache slot assignment
-        stays in order on the caller). On feed-transfer-bound PS
-        configs this hides the H2D behind compute, the same overlap the
-        dataloader prefetch ring gives epoch loops; ``lookahead=2``
-        (default) lets a slow tunnel link hide TWO blocks of transfer
-        behind one block of compute, ``lookahead=1`` is the classic
-        double-buffer (kept reachable for the overhead-guard test).
-        Returns the last block's results (matching a run_batches loop's
-        final value)."""
+                           convert_to_numpy_ret_vals=False,
+                           lookahead=None):
+        """run_batches over an iterable of blocks with the async ingest
+        engine (hetu_tpu/ingest.py) hiding the host: while block i
+        executes on device, the engine's worker stacks and device-
+        transfers the next ``lookahead`` blocks' plain feeds and
+        dataloader batches (the stateless half of the host phase —
+        cache slot assignment stays in order on the caller). Host-path
+        PS and BSP graphs — which execute per step by construction —
+        route through the PS runtime's pipelined loop instead, where
+        step i+1's feed transfer AND SparsePull overlap step i's
+        in-flight compute (``PSRuntime.run_stream_pipelined``).
+
+        ``lookahead`` (default: ``overlap_options["lookahead"]``, 2)
+        lets a slow tunnel link hide TWO blocks of transfer behind one
+        block of compute; ``lookahead=1`` is the classic double-buffer
+        (kept reachable for the overhead-guard test). With
+        ``overlap_options={"ingest": False}`` every path degrades to a
+        fully synchronous run_batches loop. Returns the last block's
+        results (matching a run_batches loop's final value)."""
+        overlap = self.config.overlap
+        if lookahead is None:
+            lookahead = overlap.lookahead
         if lookahead < 1:
             raise ValueError(f"lookahead must be >= 1, got {lookahead}")
         if name not in self.subexecutors and "default" in self.subexecutors:
             name = "default"
         sub = self.subexecutors[name]
+        if self._run_loop_advisor is not None:
+            self._run_loop_advisor.on_stream()
         from .parallel.pipeline import PipelineSubExecutor
         if isinstance(sub, PipelineSubExecutor):
             raise ValueError(
@@ -1148,47 +1257,75 @@ class Executor:
         needs_ps = (sub.ps_ops or sub.ps_lookups or sub.ps_pull_ops
                     or sub.cached_lookups)
         blocks = iter(blocks)
-        if not needs_ps or sub.ps_lookups or sub.ps_pull_ops \
-                or sub.ps_ops or self.config.bsp:
-            # host-path PS and BSP fall back to per-step run_step inside
-            # run_block and never read pre_ingested — a lookahead ingest
-            # would transfer every feed twice for nothing
+        gnn = any(isinstance(dl, GNNDataLoaderOp)
+                  for dl in sub.dataloader_ops)
+        if not overlap.ingest or gnn:
+            # engine off (or a GNN loader, whose double-buffer contract
+            # forbids reading ahead): fully synchronous blocks
             out = None
             for block in blocks:
                 out = self.run_batches(block, name,
                                        convert_to_numpy_ret_vals)
             return out
-        from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
-        rt = self.ps_runtime
+        if sub.ps_lookups or sub.ps_pull_ops or sub.ps_ops \
+                or (needs_ps and self.config.bsp):
+            # host-path PS / BSP: per-step pull/push is the semantics;
+            # the pipelined loop overlaps step i+1's host phase with
+            # step i's in-flight compute instead of serializing
+            return self.ps_runtime.run_stream_pipelined(
+                sub, blocks, convert_to_numpy_ret_vals,
+                lookahead=lookahead, sink=self._ingest_stats)
+
+        # scan-block paths: device-cached PS and plain host-feed graphs
+        rt = self.ps_runtime if needs_ps else None
+
+        def fetch_dl(block):
+            # dataloaders advance state: fetch host batches in block
+            # order on the caller; the worker only stacks + transfers
+            if not sub.dataloader_ops:
+                return None
+            return {dl: sub.dl_block(dl, len(block))
+                    for dl in sub.dataloader_ops}
+
+        def ingest_job(block, dl_host):
+            if rt is not None:
+                return rt.ingest_feeds(sub, block, dl_host=dl_host)
+            return sub.ingest_feeds(block, dl_host=dl_host)
+
         cur = next(blocks, None)
         if cur is None:
             return None
         out = None
-        # one worker keeps ingests ordered; a deque of up to `lookahead`
-        # pending (block, future) pairs keeps that worker fed ahead of
-        # the device, so ingest i+2 starts the moment i+1 finishes
-        # instead of waiting for block i's device execution to complete
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            pre = rt.ingest_feeds(sub, cur)
-            pending = deque()
-            while len(pending) < lookahead:
-                nxt = next(blocks, None)
-                if nxt is None:
-                    break
-                pending.append((nxt, pool.submit(rt.ingest_feeds, sub,
-                                                 nxt)))
+        engine = _ingest_engine.IngestEngine(
+            self.config.telemetry, lookahead=lookahead,
+            sink=self._ingest_stats)
+        blocks_enum = enumerate(blocks, start=1)
+        pending = deque()
+        with engine:    # error exit cancels queued ingests (__exit__)
+
+            def refill():
+                while engine.depth < lookahead:
+                    i, nxt = next(blocks_enum, (None, None))
+                    if nxt is None:
+                        return
+                    pending.append(nxt)
+                    engine.submit(ingest_job, nxt, fetch_dl(nxt), tag=i)
+
+            pre = ingest_job(cur, fetch_dl(cur))    # priming, inline
+            refill()
             while cur is not None:
-                out = rt.run_block(sub, cur, convert_to_numpy_ret_vals,
-                                   pre_ingested=pre)
+                if rt is not None:
+                    out = rt.run_block(sub, cur,
+                                       convert_to_numpy_ret_vals,
+                                       pre_ingested=pre)
+                else:
+                    out = sub.run_block(self, cur,
+                                        convert_to_numpy_ret_vals,
+                                        pre_ingested=pre)
                 if pending:
-                    cur, fut = pending.popleft()
-                    pre = fut.result()
-                    nxt = next(blocks, None)
-                    if nxt is not None:
-                        pending.append(
-                            (nxt, pool.submit(rt.ingest_feeds, sub,
-                                              nxt)))
+                    cur = pending.popleft()
+                    _, pre = engine.pop()
+                    refill()
                 else:
                     cur, pre = None, None
         return out

@@ -34,9 +34,10 @@ __all__ = [
     "allreduceCommunicate_op", "groupallreduceCommunicate_op",
     "parameterServerCommunicate_op", "parameterServerSparsePull_op",
     "datah2d_op", "datad2h_op", "pipeline_send_op", "pipeline_receive_op",
-    "dispatch", "AllReduceCommunicateOp", "ParameterServerCommunicateOp",
-    "ParameterServerSparsePullOp", "PipelineSendOp", "PipelineReceiveOp",
-    "DispatchOp", "DispatchGradientOp",
+    "dispatch", "AllReduceCommunicateOp", "GroupAllReduceCommunicateOp",
+    "ParameterServerCommunicateOp", "ParameterServerSparsePullOp",
+    "PipelineSendOp", "PipelineReceiveOp", "DispatchOp",
+    "DispatchGradientOp", "settle_deferred_allreduce",
 ]
 
 
@@ -46,14 +47,32 @@ class AllReduceCommunicateOp(Op):
         self.comm = comm
         self.use_indexed_slices = False
 
+    def reduce_axis(self, ectx):
+        """The mesh axis this op reduces over in explicit-collective
+        mode, or None when the SPMD partitioner owns the reduction."""
+        return getattr(ectx, "spmd_axis", None) or (
+            ectx.config.spmd_axis if ectx.config is not None else None)
+
+    def _deferred(self, ectx, val):
+        """True when this op's reduction is bucketed by the consuming
+        OptimizerOp (Executor overlap_options["bucket_bytes"]): skip
+        the per-grad collective here; settle_deferred_allreduce emits
+        one collective per size-targeted bucket instead. Sparse grads
+        never defer (their all-gather path has no bucket equivalent)."""
+        from ..ndarray import IndexedSlices
+        defer = getattr(ectx, "allreduce_defer", None)
+        return (defer is not None and self in defer
+                and not isinstance(val, IndexedSlices))
+
     def compute(self, input_vals, ectx):
         from ..ndarray import IndexedSlices
         val = input_vals[0]
-        axis = getattr(ectx, "spmd_axis", None) or (
-            ectx.config.spmd_axis if ectx.config is not None else None)
+        axis = self.reduce_axis(ectx)
         if axis is None:
             # single-program SPMD: gradient is already globally reduced by
             # the partitioner; this node is a marker.
+            return val
+        if self._deferred(ectx, val):
             return val
         if isinstance(val, IndexedSlices):
             # sparse grads: all-gather indices+values (reference
@@ -86,16 +105,97 @@ class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
         super().__init__(node_A, ctx=ctx)
         self.group = group
 
+    def reduce_axis(self, ectx):
+        return self.group or super().reduce_axis(ectx)
+
     def compute(self, input_vals, ectx):
         val = input_vals[0]
-        axis = self.group or getattr(ectx, "spmd_axis", None) or (
-            ectx.config.spmd_axis if ectx.config is not None else None)
+        axis = self.reduce_axis(ectx)
         if axis is None:
             return val          # SPMD marker (partitioner reduces)
+        if self._deferred(ectx, val):
+            return val
         try:
             return lax.pmean(val, axis)
         except NameError:
             return val          # axis not bound in this trace: marker
+
+
+def settle_deferred_allreduce(inputs, input_vals, ectx):
+    """Bucketed gradient allreduce (PyTorch-DDP-style, Li et al. VLDB
+    2020): reduce the OptimizerOp's deferred gradients in size-targeted
+    buckets — ONE ``lax.pmean`` over a flattened concat per bucket
+    instead of one collective per grad. Buckets are formed in REVERSE
+    input order: the backward produces the last layers' grads first, so
+    the early buckets close over values that are ready while the tail
+    of the backward still runs, and XLA's latency-hiding scheduler can
+    overlap their collectives with the remaining backward compute.
+    ``pmean(concat(gs)) == concat(pmean(g) for g)`` elementwise, so the
+    result is numerically identical to the per-grad path.
+
+    Returns a new input_vals list with the deferred positions replaced
+    by their bucket-reduced values; everything else passes through."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..ndarray import IndexedSlices
+
+    defer = getattr(ectx, "allreduce_defer", None)
+    bucket_bytes = ectx.config.overlap.bucket_bytes \
+        if ectx.config is not None and \
+        getattr(ectx.config, "overlap", None) is not None else None
+    if not defer or not bucket_bytes:
+        return input_vals
+    items = []          # (position, op, dense grad, axis)
+    for pos, (op, val) in enumerate(zip(inputs, input_vals)):
+        if op not in defer or val is None or \
+                isinstance(val, IndexedSlices):
+            continue
+        axis = op.reduce_axis(ectx)
+        if axis is None:
+            continue
+        items.append((pos, op, val, axis))
+    if not items:
+        return input_vals
+
+    def _pmean(val, axis, guarded):
+        if not guarded:
+            return lax.pmean(val, axis)
+        try:
+            return lax.pmean(val, axis)
+        except NameError:
+            return val      # group axis unbound in this trace: marker
+
+    out = list(input_vals)
+    # dtype-and-axis-pure buckets (concat must not promote; one
+    # collective rides one axis); guarded = GroupAllReduce's
+    # axis-may-be-unbound marker contract must survive bucketing
+    buckets, cur, cur_bytes, cur_key = [], [], 0, None
+    for pos, op, val, axis in reversed(items):
+        guarded = isinstance(op, GroupAllReduceCommunicateOp)
+        key = (str(axis), jnp.result_type(val), guarded)
+        if cur and (key != cur_key or cur_bytes >= bucket_bytes):
+            buckets.append((cur_key, axis_of, cur))
+            cur, cur_bytes = [], 0
+        cur_key, axis_of = key, axis
+        cur.append((pos, val))
+        cur_bytes += int(np.prod(val.shape)) * val.dtype.itemsize
+    if cur:
+        buckets.append((cur_key, axis_of, cur))
+    for (_, _, guarded), axis, members in buckets:
+        if len(members) == 1:
+            pos, val = members[0]
+            out[pos] = _pmean(val, axis, guarded)
+            continue
+        flat = jnp.concatenate([v.reshape(-1) for _, v in members])
+        red = _pmean(flat, axis, guarded)
+        off = 0
+        for pos, val in members:
+            n = int(np.prod(val.shape))
+            out[pos] = red[off:off + n].reshape(val.shape)
+            off += n
+    return out
 
 
 class ParameterServerCommunicateOp(Op):

@@ -254,6 +254,14 @@ class OptimizerOp(Op):
     def compute(self, input_vals, ectx):
         opt = self.optimizer
         params = opt.params
+        if getattr(ectx, "allreduce_defer", None):
+            # bucketed dp gradient sync (overlap_options["bucket_bytes"]):
+            # comm ops solely feeding this optimizer skipped their
+            # per-grad collective; reduce them here in size-targeted
+            # reverse-order buckets — see ops/comm.py
+            from .ops.comm import settle_deferred_allreduce
+            input_vals = settle_deferred_allreduce(self.inputs,
+                                                   input_vals, ectx)
         # mixed precision: update the fp32 masters, upcasting the (bf16)
         # gradients — ectx.params holds the compute-dtype copies
         masters = getattr(ectx, "master_params", None) or ectx.params

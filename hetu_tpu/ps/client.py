@@ -32,6 +32,20 @@ def _flight(kind, tid, nbytes):
 
 _flight_done = _telemetry.Telemetry.flight_complete
 
+
+def _pull_span(nbytes):
+    """``ps:pull`` trace span for one pull-family RPC. The
+    ``overlapped`` attr marks pulls issued from the async ingest worker
+    (hetu_tpu/ingest.py) — i.e. speculative pulls riding under the
+    device's in-flight compute — so the merged Perfetto trace shows the
+    pull hidden behind (not between) the dispatch spans. Returns the
+    shared null context when telemetry is off."""
+    tel = _telemetry.get_telemetry()
+    if not tel.enabled:
+        return _telemetry._NULL_SPAN
+    from ..ingest import on_worker
+    return tel.span("ps:pull", bytes=int(nbytes), overlapped=on_worker())
+
 # reference OptType mapping (ps/server/optimizer.h:15-22)
 OPT_KIND = {"SGD": 0, "Momentum": 1, "Nesterov": 2, "AdaGrad": 3,
             "Adam": 4, "None": 5}
@@ -79,7 +93,8 @@ class PSClient:
     def pull(self, tid, shape):
         out = np.empty(int(np.prod(shape)), np.float32)
         rec = _flight("ps_pull", tid, out.nbytes)
-        rc = self.lib.Pull(tid, fptr(out), out.size)
+        with _pull_span(out.nbytes):
+            rc = self.lib.Pull(tid, fptr(out), out.size)
         _flight_done(rec)
         assert rc == 0, f"Pull({tid}) failed: {rc}"
         return out.reshape(shape)
@@ -115,7 +130,9 @@ class PSClient:
         idx = as_i64(indices).ravel()
         out = np.empty((idx.size, width), np.float32)
         rec = _flight("ps_sparse_pull", tid, out.nbytes)
-        rc = self.lib.SparsePull(tid, lptr(idx), fptr(out), idx.size, width)
+        with _pull_span(out.nbytes):
+            rc = self.lib.SparsePull(tid, lptr(idx), fptr(out), idx.size,
+                                     width)
         _flight_done(rec)
         assert rc == 0, f"SparsePull({tid}) failed: {rc}"
         return out.reshape(tuple(np.shape(indices)) + (width,))
@@ -146,8 +163,10 @@ class PSClient:
         idx = as_i64(indices).ravel()
         ver = as_i64(versions).ravel()
         rec = _flight("ps_sync_embedding", tid, idx.size * 4 * width)
-        n = self.lib.SyncEmbedding(tid, int(bound), lptr(idx), lptr(ver),
-                                   idx.size, fptr(out_rows), width)
+        with _pull_span(idx.size * 4 * width):
+            n = self.lib.SyncEmbedding(tid, int(bound), lptr(idx),
+                                       lptr(ver), idx.size, fptr(out_rows),
+                                       width)
         _flight_done(rec)
         versions[...] = ver.reshape(np.shape(versions))
         return n

@@ -97,7 +97,19 @@ class PSRuntime:
         self.times = {"slot_assign": 0.0, "miss_fill": 0.0, "refresh": 0.0,
                       "dispatch": 0.0, "drain_submit": 0.0, "dense": 0.0,
                       "host_pull": 0.0, "sync_push": 0.0,
-                      "feed_ingest": 0.0}
+                      "feed_ingest": 0.0, "prefetch": 0.0,
+                      "repull": 0.0}
+        # pipelined-stream bookkeeping (run_stream_pipelined): which
+        # table ids speculative pulls read from (None = not streaming),
+        # the sparse ids the LAST run_step pushed — the driver merges
+        # them into every in-flight prep's dirty set so an overlapped
+        # pull never serves a pre-push row — and the ids of ASP pushes
+        # still in flight on the async pool: those seed NEW preps'
+        # dirty sets (a pull issued after the push was *submitted* can
+        # still read the pre-push row until the push is flushed)
+        self._track_push_tids = None
+        self._last_pushed = {}
+        self._inflight_pushed = {}
         self._closed = False
         # eager registration so save()/load() work before the first step
         self._register_all()
@@ -252,7 +264,15 @@ class PSRuntime:
         return out
 
     # ------------------------------------------------------------------
-    def run_step(self, sub, feed_dict, convert_to_numpy_ret_vals=False):
+    def run_step(self, sub, feed_dict, convert_to_numpy_ret_vals=False,
+                 prepped=None, dirty=None):
+        """One PS step. ``prepped`` (from :meth:`prep_step`, usually run
+        on the async ingest worker while the previous step's compute was
+        in flight) carries pre-transferred feeds and speculative
+        SparsePull rows; ``dirty`` maps table id -> ids pushed since the
+        prep was issued — those rows are re-pulled (after flushing
+        in-flight pushes) so the overlapped pull observes exactly the
+        post-push server state the synchronous loop would have read."""
         executor = self.executor
         client = self.client
         nworkers = max(1, client.nworkers)
@@ -273,12 +293,21 @@ class PSRuntime:
 
         feed_map = {}
         host_feeds = {}      # node -> host-side value (skip device_get)
+        spec_pulls = {}
+        if prepped is not None:
+            feed_map.update(prepped["feed_map"])
+            host_feeds.update(prepped["host_feeds"])
+            spec_pulls = prepped["pulls"]
         for node, value in feed_dict.items():
+            if node in feed_map or node in host_feeds:
+                continue        # pre-ingested on the worker
             if isinstance(value, np.ndarray):
                 host_feeds[node] = value
             if node in topo_set:
                 feed_map[node] = sub._ingest(value)
         for dl in sub.dataloader_ops:
+            if dl in feed_map:
+                continue        # pre-fetched in step order by the stream
             host_val, dev_val = sub.next_dl_batch(dl)
             if isinstance(host_val, np.ndarray):
                 host_feeds[dl] = host_val
@@ -349,6 +378,10 @@ class PSRuntime:
         # prefetch path, EmbeddingLookUp.py:27-40). Duplicate ids in the
         # batch are pulled once and scattered back on the host.
         for lk in sub.ps_lookups:
+            if lk in spec_pulls:
+                feed_map[lk] = self._settle_spec_pull(spec_pulls[lk],
+                                                      dirty)
+                continue
             with self._phase("host_pull"):
                 idx = host_ids(lk.inputs[1], "embedding lookup")
                 width = int(lk.inputs[0].shape[-1])
@@ -365,6 +398,10 @@ class PSRuntime:
         # explicit sparse-pull ops (inference path, reference
         # ParameterServerCommunicate.py:236-288) feed the same way
         for op in sub.ps_pull_ops:
+            if op in spec_pulls:
+                feed_map[op] = self._settle_spec_pull(spec_pulls[op],
+                                                      dirty)
+                continue
             idx = host_ids(op.inputs[0], "sparse pull")
             width = int(op.parameter.shape[-1])
             rows = client.sparse_pull(op.parameter.id, idx, width)
@@ -403,10 +440,21 @@ class PSRuntime:
                     self._drain_device_table(rt, wait=self.config.bsp)
 
         # 3. push PS grads / pull updated params
+        track = self._track_push_tids
+        pushed = {} if track else None
         for op, g in zip(sub.ps_ops, ps_grads):
             param = op.parameter
             tid = param.id
             if isinstance(g, IndexedSlices):
+                ids = None
+                if pushed is not None and tid in track:
+                    # ids this push dirties (an ids-only readback): the
+                    # pipelined stream merges them into every in-flight
+                    # prep's dirty set so overlapped speculative pulls
+                    # revalidate against this push
+                    ids = np.unique(np.asarray(
+                        jax.device_get(g.indices)).ravel()).tolist()
+                    pushed.setdefault(tid, set()).update(ids)
                 # cache updates are host-memory cheap and the cache object
                 # is driven from this thread — keep them inline
                 if self._push_pool is not None and \
@@ -414,6 +462,12 @@ class PSRuntime:
                     # ASP: readback + push off the critical path — the
                     # next step's pull may see the table one push stale
                     # (the reference's asynchronous PS training mode)
+                    if ids is not None:
+                        # async: the server may not have applied these
+                        # rows yet — preps submitted from now until the
+                        # next flush must revalidate them too
+                        self._inflight_pushed.setdefault(
+                            tid, set()).update(ids)
                     self._drain_done()
                     self._pending_push.append(self._push_pool.submit(
                         self._push_sparse, param, g, nworkers))
@@ -432,6 +486,9 @@ class PSRuntime:
                     if sid in executor.params:
                         executor.params[sid] = jax.device_put(
                             new_value.reshape(param.shape))
+
+        if pushed is not None:
+            self._last_pushed = pushed
 
         # 3b. dense HET drain cadence (grads already accumulated in-graph)
         if self.config.ps_dense_cached and sub.training:
@@ -460,12 +517,211 @@ class PSRuntime:
         return results
 
     # ------------------------------------------------------------------
-    def ingest_feeds(self, sub, feed_dicts):
+    def prep_step(self, sub, feed_dict, dl_host=None):
+        """The worker-safe host phase of ONE step: device-transfer the
+        plain feeds (and pre-fetched dataloader batches, ``dl_host``)
+        and speculatively ``SparsePull`` the embedding rows the step
+        needs. Stateful work — host-cache lookups, device-cache slot
+        assignment, pushes, barriers — stays on the caller;
+        :meth:`run_step` revalidates the speculative pulls against
+        pushes that landed after this prep was issued. Under
+        multi-worker BSP pulls are NOT speculated (another worker's
+        barrier-synchronized push is invisible to our dirty tracking);
+        the feed transfer still overlaps."""
+        topo_set = getattr(sub, "_topo_set", None)
+        if topo_set is None:
+            topo_set = sub._topo_set = set(sub.topo_order)
+        feed_map, host_feeds = {}, {}
+        for node, value in (feed_dict or {}).items():
+            if isinstance(value, np.ndarray):
+                host_feeds[node] = value
+            if node in topo_set:
+                feed_map[node] = sub._ingest(value)
+        for dl, host_val in (dl_host or {}).items():
+            host_val = np.asarray(host_val)
+            host_feeds[dl] = host_val
+            feed_map[dl] = sub._ingest(host_val)
+        pulls = {}
+        speculate = not (self.config.bsp
+                         and max(1, self.client.nworkers) > 1)
+        if speculate:
+            for lk in sub.ps_lookups:
+                if self.caches.get(lk.inputs[0].id) is not None:
+                    continue      # host-cache: stateful, pull inline
+                idx = host_feeds.get(lk.inputs[1])
+                if idx is None:
+                    continue      # device-resident ids: pull inline
+                pulls[lk] = self._spec_pull(
+                    lk.inputs[0].id, np.asarray(idx),
+                    int(lk.inputs[0].shape[-1]))
+            for op in sub.ps_pull_ops:
+                idx = host_feeds.get(op.inputs[0])
+                if idx is None:
+                    continue
+                pulls[op] = self._spec_pull(
+                    op.parameter.id, np.asarray(idx),
+                    int(op.parameter.shape[-1]))
+        return {"feed_map": feed_map, "host_feeds": host_feeds,
+                "pulls": pulls}
+
+    def _spec_pull(self, tid, idx, width):
+        """One speculative SparsePull (dedup'd), plus everything needed
+        to revalidate and reassemble it at consumption time."""
+        with self._phase("prefetch"):
+            uniq, inv = np.unique(idx.ravel(), return_inverse=True)
+            rows = self.client.sparse_pull(tid, uniq, width)
+        return {"tid": tid, "width": width, "uniq": uniq, "inv": inv,
+                "shape": tuple(idx.shape), "rows": rows}
+
+    def _settle_spec_pull(self, spec, dirty):
+        """Speculative rows -> the device feed, re-pulling rows whose
+        ids were pushed after the prep was issued (the pipelined
+        stream's dirty map), so the fed value equals what a synchronous
+        post-push pull would have read."""
+        tid, rows = spec["tid"], spec["rows"]
+        d = (dirty or {}).get(tid)
+        if d:
+            stale = np.isin(spec["uniq"],
+                            np.fromiter(d, dtype=np.int64, count=len(d)))
+            if stale.any():
+                with self._phase("repull"):
+                    self._flush_pushes(tid)
+                    rows[stale] = self.client.sparse_pull(
+                        tid, spec["uniq"][stale], spec["width"])
+        full = rows[spec["inv"]].reshape(spec["shape"] + (spec["width"],))
+        return jax.device_put(full)
+
+    def _flush_pushes(self, tid):
+        """Block until every submitted push that could touch ``tid``
+        has reached the server: join the ASP push pool's futures, then
+        wait out the client's outstanding requests for the tensor.
+        Post-flush the table holds every submitted push, so the
+        in-flight dirty seed for ``tid`` resets."""
+        for f in self._pending_push:
+            f.result()
+        self._pending_push.clear()
+        self.client.wait(tid)
+        self._inflight_pushed.pop(tid, None)
+
+    # ------------------------------------------------------------------
+    def run_stream_pipelined(self, sub, blocks,
+                             convert_to_numpy_ret_vals=False,
+                             lookahead=2, sink=None):
+        """Pipelined per-step execution for host-path PS and BSP
+        streams — the configs :meth:`run_block` must execute
+        step-by-step, which used to serialize every pull/transfer with
+        compute. While step i's dispatched compute is in flight, the
+        async ingest worker runs steps i+1..i+lookahead's host phase:
+        feed ``device_put`` AND speculative ``SparsePull``
+        (:meth:`prep_step`). Push/barrier order is untouched — each
+        step still pushes (and BSP-barriers) before the next step
+        executes, and speculative pulls revalidate against those pushes
+        (:meth:`run_step`'s dirty re-pull) — so results are numerically
+        identical to a synchronous run_step loop. Returns the last
+        block's per-step results (the run_batches contract)."""
+        from collections import deque
+        from .. import ingest as ingest_mod
+        from ..dataloader import GNNDataLoaderOp
+
+        spec_tids = frozenset(
+            lk.inputs[0].id for lk in sub.ps_lookups
+            if lk.inputs[0].id not in self.caches) | frozenset(
+            op.parameter.id for op in sub.ps_pull_ops)
+
+        def step_stream():
+            for block in blocks:
+                n = len(block)
+                for si, fd in enumerate(block):
+                    yield fd, si == n - 1
+
+        def fetch_dl():
+            # dataloaders advance state: fetch host batches in step
+            # order on the caller; the worker only device-transfers
+            out = {dl: sub.dl_block(dl, 1)[0]
+                   for dl in sub.dataloader_ops
+                   if not isinstance(dl, GNNDataLoaderOp)}
+            return out or None
+
+        it = enumerate(step_stream())
+        first = next(it, None)
+        if first is None:
+            return None
+        engine = ingest_mod.IngestEngine(
+            self.config.telemetry, lookahead=lookahead, name="ps-ingest",
+            sink=sink)
+        pending = deque()    # (fd, block_end, dirty) aligned with engine
+        self._track_push_tids = spec_tids or None
+        out, block_out = None, []
+        try:
+            with engine:     # error exit cancels queued preps
+
+                def refill():
+                    # low-reuse id streams grow the in-flight seed
+                    # without ever tripping a dirty re-pull (which is
+                    # what normally flushes it): past a bound, settle
+                    # the pushes now so seed copies and isin checks
+                    # stay O(bound) instead of O(stream)
+                    for t in [t for t, s in
+                              self._inflight_pushed.items()
+                              if len(s) > 4096]:
+                        self._flush_pushes(t)
+                    while engine.depth < lookahead:
+                        nxt = next(it, None)
+                        if nxt is None:
+                            return
+                        i, (fd, block_end) = nxt
+                        # seed with ids whose ASP pushes are still in
+                        # flight: this prep's pull races those pushes
+                        # even though they were submitted earlier
+                        seed = {t: set(s) for t, s
+                                in self._inflight_pushed.items() if s}
+                        pending.append((fd, block_end, seed))
+                        engine.submit(self.prep_step, sub, fd,
+                                      fetch_dl(), tag=i)
+
+                _, (fd, block_end) = first
+                # settle pushes from any PRE-stream run() steps: they
+                # predate the tracking, so the priming prep (and the
+                # first refill batch) must not race them
+                for tid in spec_tids:
+                    self._flush_pushes(tid)
+                pre = self.prep_step(sub, fd, fetch_dl())   # priming
+                dirty = {}
+                refill()
+                while fd is not None:
+                    res = self.run_step(sub, fd,
+                                        convert_to_numpy_ret_vals,
+                                        prepped=pre, dirty=dirty)
+                    block_out.append(res)
+                    if block_end:
+                        out, block_out = block_out, []
+                    pushed = self._last_pushed
+                    if pushed:
+                        # this step's pushes dirty every in-flight prep
+                        for _fd, _be, d in pending:
+                            for tid, ids in pushed.items():
+                                d.setdefault(tid, set()).update(ids)
+                    if pending:
+                        fd, block_end, dirty = pending.popleft()
+                        _, pre = engine.pop()
+                        refill()
+                    else:
+                        fd = None
+        finally:
+            self._track_push_tids = None
+            self._last_pushed = {}
+            self._inflight_pushed = {}
+        return out
+
+    # ------------------------------------------------------------------
+    def ingest_feeds(self, sub, feed_dicts, dl_host=None):
         """Stack + device-transfer a block's plain feeds (the stateless
-        part of run_block's host phase). Safe to run on a lookahead
-        thread while the previous block executes — the stateful work
-        (cache slot assignment, miss fills) stays on the caller. Returns
-        the {node: (stacked, first_row)} map run_block accepts as
+        part of run_block's host phase) and, when the caller fetched
+        them in block order, its dataloader batches (``dl_host``: {dl:
+        [per-step host arrays]}). Safe to run on the async ingest worker
+        while the previous block executes — the stateful work (cache
+        slot assignment, miss fills) stays on the caller. Returns the
+        {node: (stacked, first_row)} map run_block accepts as
         ``pre_ingested``."""
         topo_set = getattr(sub, "_topo_set", None)
         if topo_set is None:
@@ -475,6 +731,9 @@ class PSRuntime:
             if node not in topo_set:
                 continue     # e.g. raw ids replaced by the slots feed
             out[node] = sub._stack_feed([fd[node] for fd in feed_dicts])
+        for dl, arrs in (dl_host or {}).items():
+            stacked = np.stack(arrs)
+            out[dl] = (sub._ingest_stacked(stacked), stacked[0])
         return out
 
     def run_block(self, sub, feed_dicts, convert_to_numpy_ret_vals=False,
@@ -513,6 +772,8 @@ class PSRuntime:
                 feed_map[node] = stacked
                 first_map[node] = first
         for dl in sub.dataloader_ops:
+            if dl in feed_map:
+                continue     # pre-ingested (stream fetched in order)
             stacked = np.stack(sub.dl_block(dl, nsteps))
             feed_map[dl] = sub._ingest_stacked(stacked)
             first_map[dl] = stacked[0]

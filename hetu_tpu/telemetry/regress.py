@@ -24,6 +24,13 @@ __all__ = ["load_metrics", "compare", "main"]
 _LOWER_IS_BETTER = ("ms", "seconds", "s/step", "s/epoch")
 _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 
+# per-record extra fields the gate also compares when both sides carry
+# them — the unit heuristic can't see these (they ride on the metric
+# record, not as their own metric). Value: True = lower is better.
+# overlap_fraction is the ingest engine's host-hidden share (ingest.py)
+# — HIGHER is better; ingest_wait_ms is device-waited-on-host — lower.
+_FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True}
+
 
 def _metric_lines(text):
     out = {}
@@ -101,6 +108,27 @@ def compare(old, new, tolerance):
         else:
             status = "ok"
         rows.append((name, ov, nv, ratio, status))
+        for field, lower in _FIELD_DIRECTION.items():
+            if field not in o or field not in n:
+                continue
+            fo, fn = float(o[field]), float(n[field])
+            if fo == 0:
+                rows.append((f"{name}.{field}", fo, fn, None, "skipped"))
+                continue
+            if lower and fn == 0:
+                # e.g. ingest_wait_ms dropping to exactly 0.0 — the
+                # number this field exists to drive down; not a divide
+                rows.append((f"{name}.{field}", fo, fn, float("inf"),
+                             "improved"))
+                continue
+            fr = (fo / fn) if lower else (fn / fo)
+            if fr < 1.0 - tolerance:
+                fs = "REGRESSED"
+            elif fr > 1.0 + tolerance:
+                fs = "improved"
+            else:
+                fs = "ok"
+            rows.append((f"{name}.{field}", fo, fn, fr, fs))
     return rows
 
 
