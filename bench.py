@@ -136,6 +136,46 @@ _OVERLAP_FIELDS = ("ingest_wait_ms", "overlap_fraction")
 _FEED_BOUND_METRICS = ("wdl_criteo_ps", "wdl_criteo_hybrid", "ncf_ml25m")
 
 
+# perf-doctor auto-attribution: emit() drains the bench-wide tracer's
+# NEW spans (since the previous emit) through the doctor's bucket
+# engine and stamps the result onto the metric — every headline number
+# in the artifact carries its own "where did the step go" answer
+# (bucket ms/step, top exposed bucket, conservation bit) with zero
+# per-unit code. Fields stamp only when step/step_block windows landed
+# in the window, so direct emit() calls (tests) are unaffected.
+_doctor_seen_ts = 0.0
+
+
+def _doctor_fields():
+    tel = _telemetry()
+    if not tel.enabled or tel.tracer is None:
+        return {}
+    global _doctor_seen_ts
+    events = [e for e in tel.tracer.drain() if e.get("ph") != "M"]
+    # freshness by COMPLETION time (ts + dur): a span in flight at the
+    # previous emit completes after it and must still attribute to the
+    # next metric — a start-ts watermark would drop it forever
+    fresh = [e for e in events
+             if e.get("ts", 0) + e.get("dur", 0) > _doctor_seen_ts]
+    if events:
+        _doctor_seen_ts = max(e.get("ts", 0) + e.get("dur", 0)
+                              for e in events)
+    from hetu_tpu.telemetry import doctor
+    attr = doctor.attribute_events(fresh)
+    if attr is None:
+        return {}
+    per_step = {b: round(v, 4)
+                for b, v in attr["per_step_ms"].items() if v > 0}
+    ranked = sorted(((b, v) for b, v in per_step.items()
+                     if b not in ("compute", "jit")),
+                    key=lambda kv: -kv[1])
+    out = {"bucket_ms_per_step": per_step,
+           "buckets_conserve": attr["conserved"]}
+    if ranked:
+        out["top_bucket"] = ranked[0][0]
+    return out
+
+
 def emit(metric, value, unit, vs, **extra):
     if unit != "error":
         missing = [k for k in _ATTRIBUTION_FIELDS if k not in extra]
@@ -147,6 +187,8 @@ def emit(metric, value, unit, vs, **extra):
                 f"fields {missing}; every metric must carry h2d_MBps "
                 f"and p50/p95 step time, and feed-bound units the "
                 f"ingest overlap accounting (add them, don't drop them)")
+        for k, v in _doctor_fields().items():
+            extra.setdefault(k, v)
     rec = {"metric": metric, "value": round(float(value), 1),
            "unit": unit, "vs_baseline": round(float(vs), 3)}
     for k, v in extra.items():

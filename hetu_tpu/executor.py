@@ -567,6 +567,7 @@ class SubExecutor:
                 and inp not in eval_set
                 and all(c in optimizer_set
                         for c in consumers.get(inp, ())))
+        self._allreduce_defer_n = len(allreduce_defer)
 
         def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
             # per-step key folded INSIDE the jit: an eager fold_in per
@@ -678,7 +679,12 @@ class SubExecutor:
         t0 = tel.clock()
         yield
         t1 = tel.clock()
-        args = {"subgraph": self.name, "shape_key": str(key)}
+        args = {"subgraph": self.name, "shape_key": str(key),
+                # how many optimizer-bound allreduce collectives this
+                # build deferred into buckets (overlap_options
+                # bucket_bytes) — 0 when bucketing is off, so the
+                # doctor can tell bucketed from per-grad traces
+                "allreduce_defer": getattr(self, "_allreduce_defer_n", 0)}
         if getattr(self, "_last_mem", None):
             # memory_analysis numbers ride the jit_compile span
             args.update(self._last_mem)
@@ -786,7 +792,8 @@ class SubExecutor:
                      feeds, lrs, np.int32(self.step_count),
                      executor.base_rng))
         fn = self.compiled[key]
-        with self.config.telemetry.span("block_dispatch"):
+        with self.config.telemetry.span("block_dispatch", steps=nsteps,
+                                        subgraph=self.name):
             outs, new_params, new_state, new_opt = fn(
                 executor.params, executor.state, executor.opt_state,
                 feeds, lrs, np.int32(self.step_count), executor.base_rng)
@@ -894,7 +901,8 @@ class SubExecutor:
                     self.trace_args(executor, feed_map))
         fn = self.compiled[key]
 
-        with self.config.telemetry.span("device_dispatch"):
+        with self.config.telemetry.span("device_dispatch",
+                                        subgraph=self.name):
             outputs, new_params, new_state, new_opt, _ = fn(
                 *self.trace_args(executor, feed_map))
         if self.training:
@@ -1199,18 +1207,26 @@ class Executor:
                     or sub.cached_lookups)
         if self._run_loop_advisor is not None:
             self._run_loop_advisor.on_stream()
+        tel = self.config.telemetry
+        # step_block is the doctor's attribution window for block
+        # paths: `steps` weights the window so bucket sums divide into
+        # honest per-step numbers (a 100-step scan block is 100 steps
+        # of wall, not one)
+        span = tel.span("step_block", steps=len(feed_dicts),
+                        subgraph=name) if tel.enabled else \
+            _telemetry.NULL.span("")
         try:
-            if needs_ps:
-                out = self.ps_runtime.run_block(
-                    sub, feed_dicts, convert_to_numpy_ret_vals)
-            else:
-                out = sub.run_block(self, feed_dicts,
-                                    convert_to_numpy_ret_vals)
+            with span:
+                if needs_ps:
+                    out = self.ps_runtime.run_block(
+                        sub, feed_dicts, convert_to_numpy_ret_vals)
+                else:
+                    out = sub.run_block(self, feed_dicts,
+                                        convert_to_numpy_ret_vals)
         except Exception as e:
             if _memory.is_oom(e):
                 self._report_oom(e)
             raise
-        tel = self.config.telemetry
         if tel.enabled:
             tel.flight_step(sub.step_count)
         if self._heartbeat is not None:
@@ -1311,23 +1327,33 @@ class Executor:
                     pending.append(nxt)
                     engine.submit(ingest_job, nxt, fetch_dl(nxt), tag=i)
 
+            tel = self.config.telemetry
             pre = ingest_job(cur, fetch_dl(cur))    # priming, inline
             refill()
             while cur is not None:
-                if rt is not None:
-                    out = rt.run_block(sub, cur,
-                                       convert_to_numpy_ret_vals,
-                                       pre_ingested=pre)
-                else:
-                    out = sub.run_block(self, cur,
-                                        convert_to_numpy_ret_vals,
-                                        pre_ingested=pre)
-                if pending:
-                    cur = pending.popleft()
-                    _, pre = engine.pop()
-                    refill()
-                else:
-                    cur, pre = None, None
+                # the window covers the block dispatch AND the pop wait
+                # for the next block's ingest: the ingest_wait span the
+                # engine records lands inside it, so an exposed host
+                # stall is attributable instead of falling between
+                # windows
+                span = tel.span("step_block", steps=len(cur),
+                                subgraph=name) if tel.enabled else \
+                    _telemetry.NULL.span("")
+                with span:
+                    if rt is not None:
+                        out = rt.run_block(sub, cur,
+                                           convert_to_numpy_ret_vals,
+                                           pre_ingested=pre)
+                    else:
+                        out = sub.run_block(self, cur,
+                                            convert_to_numpy_ret_vals,
+                                            pre_ingested=pre)
+                    if pending:
+                        cur = pending.popleft()
+                        _, pre = engine.pop()
+                        refill()
+                    else:
+                        cur, pre = None, None
         return out
 
     def get_batch_num(self, name="default"):

@@ -10,11 +10,15 @@ Nodes are annotated with the executor's parallel placement: pipeline
 stage (color) and TP PartitionSpec / NodeStatus when the planner
 assigned one.
 
-``costs=`` (the output of ``profiler.profile_ops``, or any
-``{op_name: ms}`` map) overlays per-op cost heat coloring: node fill
-interpolates pale-yellow -> red by cost relative to the most expensive
-op, and the measured ms joins the node's sublabel — the graph view and
-the profiler reading off one artifact.
+``costs=`` (the output of ``profiler.profile_ops``, any
+``{op_name: ms}`` map, a ``telemetry.costdb.CostDB`` instance, or a
+**path to a CostDB JSON file**) overlays per-op cost heat coloring:
+node fill interpolates pale-yellow -> red by cost relative to the most
+expensive op, and the measured ms joins the node's sublabel — the
+graph view and the profiler reading off one artifact. In CostDB mode
+each node is looked up by (op kind, inferred shape) and the tooltip
+says whether the ms is a DB **hit** or the node has **no DB entry**
+(a coverage gap `profile_op_records(costdb=...)` would fill).
 
 ``findings=`` (an ``analysis.Report``, a list of findings, or a
 ``{op_name: severity}`` map) overlays the preflight verifier's
@@ -46,6 +50,36 @@ def _cost_map(costs):
     for name, ms in items:
         out[str(name)] = out.get(str(name), 0.0) + float(ms)
     return out
+
+
+def _resolve_costs(costs, topo):
+    """Normalize the ``costs=`` overlay input.
+
+    Returns ``(cmap, dbinfo)``: ``cmap`` is {op_name: ms}; ``dbinfo``
+    is None for raw profile input, else {op_name: "hit"|"miss"} from a
+    per-node CostDB lookup — a str/PathLike loads the DB file, a
+    ``CostDB`` instance is queried directly (kind + inferred shape,
+    ``CostDB.lookup_node``)."""
+    if costs is None:
+        return {}, None
+    # `is None`, not falsiness: an EMPTY CostDB instance must still
+    # take the DB branch so every node gets its explicit miss mark
+    from .telemetry.costdb import CostDB
+    if isinstance(costs, (str, os.PathLike)):
+        db = CostDB(costs)
+    elif isinstance(costs, CostDB):
+        db = costs
+    else:
+        return _cost_map(costs), None
+    cmap, dbinfo = {}, {}
+    for node in topo:
+        ent = db.lookup_node(node)
+        if ent is None:
+            dbinfo[node.name] = "miss"
+        else:
+            dbinfo[node.name] = "hit"
+            cmap[node.name] = cmap.get(node.name, 0.0) + float(ent["ms"])
+    return cmap, dbinfo
 
 
 _FINDING_STROKE = {"error": "#cc1f1f", "warn": "#e08a00",
@@ -133,7 +167,7 @@ def to_dot(executor, costs=None, findings=None):
     exactly like ``render``."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
-    cmap = _cost_map(costs)
+    cmap, dbinfo = _resolve_costs(costs, topo)
     fmap = _finding_map(findings)
     max_cost = max(cmap.values()) if cmap else 0.0
     lines = ["digraph hetu {", "  rankdir=TB;",
@@ -148,7 +182,12 @@ def to_dot(executor, costs=None, findings=None):
         cost = cmap.get(node.name)
         if cost is not None:
             label += f"\\n{cost:.3f} ms"
+            if dbinfo is not None:
+                label += " (DB)"
             color = _heat_color(cost / max_cost if max_cost else 0.0)
+        elif dbinfo is not None and dbinfo.get(node.name) == "miss":
+            label += "\\n(no DB entry)"
+            color = "#eeeeee"
         elif stage is not None:
             color = _STAGE_COLORS[stage % len(_STAGE_COLORS)]
         else:
@@ -208,7 +247,7 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
     severity-colored border and their HT codes."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
-    cmap = _cost_map(costs)
+    cmap, dbinfo = _resolve_costs(costs, topo)
     fmap = _finding_map(findings)
     max_cost = max(cmap.values()) if cmap else 0.0
     coords, order = _layout(topo)
@@ -251,6 +290,10 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
         title = html.escape(getattr(node, "desc", node.name))
         if cost is not None:
             title += html.escape(f" — {cost:.3f} ms")
+            if dbinfo is not None:
+                title += html.escape(" (cost DB hit)")
+        elif dbinfo is not None and dbinfo.get(node.name) == "miss":
+            title += html.escape(" — no cost DB entry")
         hit = fmap.get(node.name)
         stroke, swidth, codes_txt = "#888", 1, None
         if hit is not None:

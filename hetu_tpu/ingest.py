@@ -222,6 +222,8 @@ class IngestEngine:
         pipeline-fill pops that are expected to wait."""
         tag, fut = self._pending.popleft()
         self._gauge()
+        tel_on = self.tel is not None and self.tel.enabled
+        t0n = self.tel.clock() if tel_on else 0
         t0 = time.perf_counter()
         try:
             result = fut.result()
@@ -232,7 +234,14 @@ class IngestEngine:
         if record_wait:
             dt = (time.perf_counter() - t0) * 1000.0
             self.wait_ms.append(dt)
-            if self.tel is not None and self.tel.enabled:
+            if tel_on:
+                # the stall is a first-class span, not just a histogram:
+                # the doctor attributes it to the h2d_ingest bucket as
+                # EXPOSED host time (it rides the consumer thread, on
+                # the critical path — unlike the worker's overlapped=
+                # transfers)
+                self.tel.complete("ingest_wait", t0n, self.tel.clock(),
+                                  {"tag": tag})
                 self.tel.observe("ingest_wait_ms", dt)
         return tag, result
 

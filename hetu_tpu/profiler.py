@@ -21,7 +21,7 @@ import time
 
 import numpy as np
 
-__all__ = ["StepLogger", "profile_ops", "trace"]
+__all__ = ["StepLogger", "profile_ops", "profile_op_records", "trace"]
 
 
 class StepLogger:
@@ -84,11 +84,15 @@ class StepLogger:
         return self._f.closed
 
 
-def profile_ops(executor, feed_dict=None, name="default", top=20,
-                printout=True):
-    """Per-op cost attribution: execute the step's topo order eagerly,
-    blocking after each op (reference HetuProfiler's per-node timers).
-    Returns [(op_name, ms)] sorted by cost."""
+def profile_op_records(executor, feed_dict=None, name="default",
+                       costdb=None):
+    """Per-op cost attribution with full op *identity*: execute the
+    step's topo order eagerly, blocking after each op, and return one
+    record per op — ``{"name", "kind", "shape", "dtype", "ms"}`` —
+    with exactly the fields a ``telemetry.costdb.CostDB`` entry is
+    keyed on. ``costdb=`` (a CostDB instance or a path) folds every
+    record straight into the persistent database, source-tagged
+    ``profile_ops``."""
     import jax
 
     from .graph.node import ExecContext
@@ -113,7 +117,7 @@ def profile_ops(executor, feed_dict=None, name="default", top=20,
     ectx.step = 0
 
     env = dict(feed_map)
-    times = []
+    records = []
     for node in sub.topo_order:
         if node in env or node in sub.optimizer_ops:
             continue
@@ -130,9 +134,32 @@ def profile_ops(executor, feed_dict=None, name="default", top=20,
             jax.block_until_ready(out)
         except Exception:
             pass                      # pytree values (IndexedSlices etc.)
-        times.append((node.name, (time.perf_counter() - t0) * 1000))
+        ms = (time.perf_counter() - t0) * 1000
+        dtype = getattr(out, "dtype", None)
+        records.append({
+            "name": node.name,
+            "kind": type(node).__name__,
+            "shape": getattr(node, "inferred_shape", None),
+            "dtype": str(dtype) if dtype is not None else "float32",
+            "ms": ms})
         env[node] = out
-    times.sort(key=lambda kv: -kv[1])
+    records.sort(key=lambda r: -r["ms"])
+    if costdb is not None:
+        from .telemetry.costdb import CostDB, record_profile
+        db = costdb if isinstance(costdb, CostDB) else CostDB(costdb)
+        record_profile(db, records)
+    return records
+
+
+def profile_ops(executor, feed_dict=None, name="default", top=20,
+                printout=True, costdb=None):
+    """Per-op cost attribution: execute the step's topo order eagerly,
+    blocking after each op (reference HetuProfiler's per-node timers).
+    Returns [(op_name, ms)] sorted by cost; ``costdb=`` additionally
+    persists each measurement (see ``profile_op_records``)."""
+    records = profile_op_records(executor, feed_dict, name=name,
+                                 costdb=costdb)
+    times = [(r["name"], r["ms"]) for r in records]
     if printout:
         total = sum(t for _, t in times)
         print(f"per-op profile ({len(times)} ops, eager total "

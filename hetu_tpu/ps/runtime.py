@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _telemetry
 from ..ndarray import IndexedSlices
 from .device_cache import DeviceCacheTable, pad_fill, pad_gather_zero
 
@@ -688,25 +689,35 @@ class PSRuntime:
                 pre = self.prep_step(sub, fd, fetch_dl())   # priming
                 dirty = {}
                 refill()
+                tel = self.config.telemetry
                 while fd is not None:
-                    res = self.run_step(sub, fd,
-                                        convert_to_numpy_ret_vals,
-                                        prepped=pre, dirty=dirty)
-                    block_out.append(res)
-                    if block_end:
-                        out, block_out = block_out, []
-                    pushed = self._last_pushed
-                    if pushed:
-                        # this step's pushes dirty every in-flight prep
-                        for _fd, _be, d in pending:
-                            for tid, ids in pushed.items():
-                                d.setdefault(tid, set()).update(ids)
-                    if pending:
-                        fd, block_end, dirty = pending.popleft()
-                        _, pre = engine.pop()
-                        refill()
-                    else:
-                        fd = None
+                    # per-step doctor window (pipelined path dispatches
+                    # per step, there is no covering Executor.run span);
+                    # the engine.pop wait lands inside it, so an
+                    # exposed prep stall is attributable
+                    span = tel.span("step", subgraph=sub.name,
+                                    pipelined=True) if tel.enabled \
+                        else _telemetry.NULL.span("")
+                    with span:
+                        res = self.run_step(sub, fd,
+                                            convert_to_numpy_ret_vals,
+                                            prepped=pre, dirty=dirty)
+                        block_out.append(res)
+                        if block_end:
+                            out, block_out = block_out, []
+                        pushed = self._last_pushed
+                        if pushed:
+                            # this step's pushes dirty every in-flight
+                            # prep
+                            for _fd, _be, d in pending:
+                                for tid, ids in pushed.items():
+                                    d.setdefault(tid, set()).update(ids)
+                        if pending:
+                            fd, block_end, dirty = pending.popleft()
+                            _, pre = engine.pop()
+                            refill()
+                        else:
+                            fd = None
         finally:
             self._track_push_tids = None
             self._last_pushed = {}

@@ -11,7 +11,12 @@ lines, eager ``profile_ops``, the PS runtime's raw ``times`` dict):
   p50/p95/p99 histograms (metrics.py), exportable as JSONL and as a
   Prometheus text scrape (``MetricsRegistry.serve``).
 * ``python -m hetu_tpu.telemetry.check trace.json`` — schema validator
-  (check.py).
+  (check.py), including the typed span-attr schema (``SPAN_SCHEMA``).
+* ``python -m hetu_tpu.telemetry.doctor <dir>`` — trace analytics:
+  per-step critical-path bucket attribution with a conservation check
+  and a ranked perf diagnosis (doctor.py), backed by the persistent
+  measured cost database (costdb.py) the auto-parallelism cost model
+  queries.
 
 Wiring: ``Executor(..., telemetry=...)`` threads an instance through
 the executor, PS runtime, p2p channel and all pipeline runners; the

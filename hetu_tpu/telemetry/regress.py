@@ -19,7 +19,8 @@ import argparse
 import json
 import sys
 
-__all__ = ["load_metrics", "compare", "main"]
+__all__ = ["load_metrics", "compare", "history", "history_markdown",
+           "main"]
 
 _LOWER_IS_BETTER = ("ms", "seconds", "s/step", "s/epoch")
 _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
@@ -132,13 +133,105 @@ def compare(old, new, tolerance):
     return rows
 
 
+def _round_label(path):
+    """Short column label for a bench round file: BENCH_r05.json ->
+    r05; anything else keeps its basename stem."""
+    import os
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if stem.startswith("BENCH_"):
+        return stem[len("BENCH_"):]
+    return stem
+
+
+def history(paths):
+    """Metric trajectories across ALL bench rounds, not just two files:
+    returns (labels, {metric: {"unit": u, "values": [v_or_None per
+    round]}}) in the given file order. A two-file compare answers "did
+    this PR regress"; the trajectory answers "where did this metric's
+    history bend" without opening five round files by hand."""
+    labels = [_round_label(p) for p in paths]
+    rounds = [load_metrics(p) for p in paths]
+    names = sorted({n for r in rounds for n in r})
+    table = {}
+    for name in names:
+        # unit from the first round with a REAL record: a unit that
+        # errored in r01 but recovered later must keep its trajectory
+        unit = next((r[name].get("unit") for r in rounds
+                     if name in r and r[name].get("unit") != "error"),
+                    None)
+        if unit is None:
+            continue                # errored in every round
+        values = []
+        for r in rounds:
+            rec = r.get(name)
+            try:
+                v = None if rec is None or rec.get("unit") == "error" \
+                    else float(rec["value"])
+            except (TypeError, ValueError):
+                v = None            # structured values (phase dicts)
+            values.append(v)
+        if any(v is not None for v in values):
+            table[name] = {"unit": unit, "values": values}
+    return labels, table
+
+
+def history_markdown(labels, table, tolerance=0.15):
+    """Markdown trajectory table: one row per metric, one column per
+    round, the last column calling the latest-vs-previous move
+    (improved / REGRESSED / ok by the unit-inferred direction)."""
+    lines = ["| metric | unit | " + " | ".join(labels) + " | trend |",
+             "|---|---|" + "---|" * (len(labels) + 1)]
+    for name in sorted(table):
+        row = table[name]
+        vals = row["values"]
+        cells = ["-" if v is None else f"{v:g}" for v in vals]
+        # the trend column calls the LATEST round's move; a missing/
+        # errored latest value is "-", never a verdict about two older
+        # rounds
+        last = vals[-1]
+        prior = [v for v in vals[:-1] if v is not None]
+        if last is None:
+            trend = "-"
+        elif not prior:
+            trend = "new"
+        else:
+            prev = prior[-1]
+            if prev == 0 or last == 0:
+                # bench rounds values: a sub-0.05ms step lands as 0.0;
+                # a zero on either side has no meaningful ratio
+                trend = "improved" if last == 0 and prev > 0 \
+                    and _lower_is_better(row["unit"]) else "-"
+            else:
+                ratio = (prev / last) if _lower_is_better(row["unit"]) \
+                    else (last / prev)
+                if ratio < 1.0 - tolerance:
+                    trend = f"REGRESSED x{ratio:.2f}"
+                elif ratio > 1.0 + tolerance:
+                    trend = f"improved x{ratio:.2f}"
+                else:
+                    trend = "ok"
+        lines.append(f"| {name} | {row['unit'] or ''} | "
+                     + " | ".join(cells) + f" | {trend} |")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m hetu_tpu.telemetry.regress",
-        description="compare two bench result files metric-by-metric; "
-                    "exit 1 on regression")
-    parser.add_argument("old", help="baseline BENCH_*.json (or JSONL)")
-    parser.add_argument("new", help="candidate BENCH_*.json (or JSONL)")
+        description="compare two bench result files metric-by-metric "
+                    "(exit 1 on regression), or --history over ALL "
+                    "rounds for a markdown trajectory table")
+    parser.add_argument("files", nargs="+",
+                        help="BENCH_*.json (or JSONL) files: exactly "
+                             "two (old new) without --history, any "
+                             "number in round order with it")
+    parser.add_argument("--history", action="store_true",
+                        help="emit a metric-trajectory markdown table "
+                             "across every given round file instead of "
+                             "gating two")
+    parser.add_argument("--markdown", default=None, metavar="PATH",
+                        help="with --history: also write the table to "
+                             "this file (the CI artifact)")
     parser.add_argument("--tolerance", type=float, default=0.15,
                         help="relative slack before a metric counts as "
                              "regressed (default 0.15)")
@@ -151,8 +244,31 @@ def main(argv=None):
                              "exit 2: a broken pipeline is not a perf "
                              "delta")
     args = parser.parse_args(argv)
+    if args.history:
+        try:
+            labels, table = history(args.files)
+        except OSError as e:
+            print(f"cannot read bench file: {e}", file=sys.stderr)
+            return 2
+        if not table:
+            print("no metrics parsed from any round file",
+                  file=sys.stderr)
+            return 2
+        md = history_markdown(labels, table,
+                              tolerance=args.tolerance)
+        print(md)
+        if args.markdown:
+            with open(args.markdown, "w") as f:
+                f.write(f"# Bench trajectory ({len(labels)} rounds)\n\n"
+                        + md + "\n")
+        return 0
+    if len(args.files) != 2:
+        print("exactly two files (old new) required without --history",
+              file=sys.stderr)
+        return 2
+    old_path, new_path = args.files
     try:
-        old, new = load_metrics(args.old), load_metrics(args.new)
+        old, new = load_metrics(old_path), load_metrics(new_path)
     except OSError as e:
         # unreadable input = broken machinery (exit 2, never the
         # perf-regression exit 1, never suppressed by --warn-only)
@@ -161,8 +277,8 @@ def main(argv=None):
     if not old or not new:
         # broken machinery, not a perf delta: fails even under
         # --warn-only (which scopes to regressions only)
-        print(f"no metrics parsed ({args.old}: {len(old)}, "
-              f"{args.new}: {len(new)})", file=sys.stderr)
+        print(f"no metrics parsed ({old_path}: {len(old)}, "
+              f"{new_path}: {len(new)})", file=sys.stderr)
         return 2
     rows = compare(old, new, args.tolerance)
     regressed = 0

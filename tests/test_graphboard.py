@@ -73,6 +73,39 @@ def test_cost_heat_overlay(tmp_path):
     assert hot in page2 and _heat_color(0.1) in page2
 
 
+def test_costdb_overlay_path_and_instance(tmp_path):
+    """``costs=`` accepts a CostDB path (or instance) directly: nodes
+    resolve by (kind, inferred shape) with measured ms in the sublabel,
+    the tooltip says DB hit, and un-measured nodes are marked as
+    coverage misses instead of silently blending in."""
+    from hetu_tpu.profiler import profile_op_records
+    from hetu_tpu.telemetry.costdb import CostDB
+
+    x, y_, loss, train = _mlp()
+    exe = Executor([loss, train])
+    rng = np.random.RandomState(2)
+    feeds = {x: rng.randn(8, 12).astype("f"),
+             y_: np.eye(4, dtype="f")[rng.randint(0, 4, 8)]}
+    exe.run(feed_dict=feeds)
+    db_path = str(tmp_path / "costdb.json")
+    profile_op_records(exe, feeds, costdb=db_path)
+
+    out = graphboard.render(exe, str(tmp_path / "db.html"),
+                            costs=db_path)          # path form
+    page = open(out).read()
+    dot = open(str(tmp_path / "db.dot")).read()
+    assert "cost DB hit" in page
+    assert " ms" in page and "(DB)" in dot
+    # placeholders/params are never profiled: they surface as misses
+    assert "no cost DB entry" in page
+    assert "(no DB entry)" in dot
+    # instance form renders identically
+    page2 = open(graphboard.render(
+        exe, str(tmp_path / "db2.html"),
+        costs=CostDB(db_path))).read()
+    assert "cost DB hit" in page2
+
+
 def test_pipeline_stage_annotations(tmp_path):
     with ht.context(ht.cpu(0)):
         x = ht.Variable("pb_x", trainable=False)
