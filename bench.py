@@ -176,6 +176,23 @@ def _doctor_fields():
     return out
 
 
+def _health_fields():
+    """Training health stamps for the headline metrics: when the run's
+    health monitor sampled (HETU_HEALTH / Executor(health_options=...)),
+    every training metric carries ``loss_finite`` and the final
+    sampled grad norm — so a bench artifact that trained on NaNs says
+    so on its face. regress.py treats both as informational (reported,
+    never direction-compared)."""
+    from hetu_tpu.telemetry import health
+    s = health.last_summary()
+    if s is None:
+        return {}
+    out = {"loss_finite": bool(s.get("loss_finite", True))}
+    if s.get("grad_norm_total") is not None:
+        out["grad_norm_final"] = s["grad_norm_total"]
+    return out
+
+
 def emit(metric, value, unit, vs, **extra):
     if unit != "error":
         missing = [k for k in _ATTRIBUTION_FIELDS if k not in extra]
@@ -188,6 +205,8 @@ def emit(metric, value, unit, vs, **extra):
                 f"and p50/p95 step time, and feed-bound units the "
                 f"ingest overlap accounting (add them, don't drop them)")
         for k, v in _doctor_fields().items():
+            extra.setdefault(k, v)
+        for k, v in _health_fields().items():
             extra.setdefault(k, v)
     rec = {"metric": metric, "value": round(float(value), 1),
            "unit": unit, "vs_baseline": round(float(vs), 3)}

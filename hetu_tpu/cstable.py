@@ -12,6 +12,7 @@ import ctypes
 import numpy as np
 
 from .ps.native_lib import as_f32, as_i64, fptr, get_lib, lptr
+from .telemetry import health as _health
 
 __all__ = ["CacheSparseTable"]
 
@@ -53,20 +54,50 @@ class CacheSparseTable:
         self.handle = self.lib.CacheCreate(
             node_id, self.limit, self.width, _POLICIES[policy],
             int(pull_bound), int(push_bound))
+        self.push_bound = int(push_bound)
+        # observed-staleness shadow (telemetry/health.py): per-key
+        # pending-update counts since the last explicit flush. The C
+        # cache also flushes internally on its own bound, so these are
+        # an UPPER bound on true staleness — histogram-only, never a
+        # trip. Maintained only while a health monitor is live.
+        # ``health_monitor`` (stamped by the PS runtime at
+        # registration) scopes observations to the owning executor.
+        self._upd_pending = {}
+        self.health_monitor = None
 
     def embedding_lookup(self, keys):
         idx = as_i64(keys).ravel()
         out = np.empty((idx.size, self.width), np.float32)
         self.lib.CacheLookup(self.handle, lptr(idx), idx.size, fptr(out))
+        if self._upd_pending and (self.health_monitor is not None
+                                  or _health.active()):
+            uniq = np.unique(idx)
+            obs = np.fromiter(
+                (self._upd_pending.get(int(i), 0) for i in uniq),
+                np.int64, count=len(uniq))
+            obs = obs[obs > 0]
+            if len(obs):
+                _health.observe_staleness("cstable", self.node_id, obs,
+                                          self.push_bound,
+                                          monitor=self.health_monitor)
         return out.reshape(tuple(np.shape(keys)) + (self.width,))
 
     def embedding_update(self, keys, grads):
         idx = as_i64(keys).ravel()
         g = as_f32(grads).reshape(idx.size, self.width)
         self.lib.CacheUpdate(self.handle, lptr(idx), fptr(g), idx.size)
+        if self.health_monitor is not None or _health.active():
+            uniq, counts = np.unique(idx, return_counts=True)
+            pend = self._upd_pending
+            for i, n in zip(uniq, counts):
+                i = int(i)
+                pend[i] = pend.get(i, 0) + int(n)
+            if len(pend) > (1 << 16):
+                pend.clear()     # bound memory; counts restart (approx)
 
     def flush(self):
         self.lib.CacheFlush(self.handle)
+        self._upd_pending.clear()
 
     # -- perf counters (reference cstable.py:126-187) -------------------
     @property

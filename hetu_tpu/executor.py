@@ -110,7 +110,8 @@ class HetuConfig:
                  pipedream=False, dynamic_memory=False, mesh=None,
                  dtype=None, num_microbatches=None, drain_compress=False,
                  pipeline_mode=None, pp_options=None, telemetry=None,
-                 validate=None, overlap_options=None):
+                 validate=None, overlap_options=None,
+                 health_options=None):
         maybe_init_distributed()
         # unified runtime telemetry (span tracer + metrics registry):
         # None resolves to the env-driven process default (enabled when
@@ -152,6 +153,19 @@ class HetuConfig:
         # defaults preserve pre-existing behavior everywhere)
         self.overlap = _ingest_engine.OverlapOptions.resolve(
             overlap_options)
+        # training health monitor (telemetry/health.py): device-side
+        # numerics sentinels fused into the jitted step + sparse-side
+        # staleness/skew telemetry, checked at cadence every_n. None
+        # resolves from HETU_HEALTH (exported by `heturun --health`);
+        # disabled => health_monitor is None and the per-step cost is
+        # one `is None` check (the tracer's null-path contract).
+        # Imported lazily so `python -m hetu_tpu.telemetry.health`
+        # stays a clean runpy target.
+        from .telemetry import health as _health
+        self.health = _health.HealthOptions.resolve(health_options)
+        self.health_monitor = (
+            _health.HealthMonitor(self.health, self.telemetry)
+            if self.health.enabled else None)
         self.num_microbatches = num_microbatches
         self.dynamic_memory = dynamic_memory
         self.dtype = dtype
@@ -568,6 +582,15 @@ class SubExecutor:
                 and all(c in optimizer_set
                         for c in consumers.get(inp, ())))
         self._allreduce_defer_n = len(allreduce_defer)
+        # training health sentinels (telemetry/health.py): when the
+        # monitor is on, OptimizerOp.compute captures per-layer grad
+        # norms / nonfinite counts / update ratios into the trace and
+        # the step returns them (plus the scalar loss) as ONE auxiliary
+        # pytree — fetched by the monitor at cadence, no extra device
+        # work or host syncs per off-cadence step. Off => health is
+        # None and the compiled program is byte-identical to before.
+        health_on = config.health_monitor is not None and training
+        self._health_loss_name = None
 
         def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
             # per-step key folded INSIDE the jit: an eager fold_in per
@@ -575,6 +598,8 @@ class SubExecutor:
             rng = jax.random.fold_in(rng, step_idx)
             ectx = ExecContext(training=training, base_rng=rng,
                                config=config)
+            if health_on:
+                ectx.health_sentinels = []
             if allreduce_defer:
                 ectx.allreduce_defer = allreduce_defer
             ectx.params = {n: params[str(n.id)] for n in param_order}
@@ -624,7 +649,55 @@ class SubExecutor:
             # the PS runtime pushes them after the step
             ps_grads = [env[op.inputs[0]] if op.inputs else None
                         for op in ps_ops]
-            return outputs, new_params, new_state, new_opt, ps_grads
+            health = None
+            if health_on:
+                from .optimizer import sentinel_stats
+                layers = {}
+                for name, m in ectx.health_sentinels:
+                    key, k = name, 2
+                    while key in layers:
+                        key, k = f"{name}#{k}", k + 1
+                    layers[key] = m
+                # PS-pushed grads update server-side and never reach an
+                # OptimizerOp here — sentinel them too, so a poisoned
+                # embedding gradient is as visible as a dense one
+                for op, g in zip(ps_ops, ps_grads):
+                    if g is not None and hasattr(op, "parameter"):
+                        layers[f"ps:{op.parameter.name}"] = \
+                            sentinel_stats(None, g, None)
+                health = {"layers": layers}
+                # the loss sentinel: a scalar floating eval output,
+                # preferring one whose NAME says loss (a scalar metric
+                # like accuracy evaluated first must not become the
+                # loss_finite signal), else the first scalar
+                loss_node, loss_val = None, None
+                for n in eval_nodes:
+                    if n in optimizer_set:
+                        continue
+                    v = env.get(n)
+                    if v is None or not hasattr(v, "shape") \
+                            or not hasattr(v, "dtype"):
+                        continue
+                    try:
+                        size = int(np.prod(v.shape))
+                    except (TypeError, ValueError):
+                        continue
+                    if size == 1 and jnp.issubdtype(v.dtype,
+                                                    jnp.floating):
+                        name = (getattr(n, "name", "") or "").lower()
+                        if "loss" in name:
+                            loss_node, loss_val = n, v
+                            break
+                        if loss_node is None:
+                            loss_node, loss_val = n, v
+                if loss_node is not None:
+                    health["loss"] = jnp.reshape(loss_val, ()).astype(
+                        jnp.float32)
+                    # trace-time side effect: deterministic per build,
+                    # read by the monitor for trip naming
+                    self._health_loss_name = loss_node.name
+            return outputs, new_params, new_state, new_opt, ps_grads, \
+                health
 
         return step_fn
 
@@ -709,16 +782,20 @@ class SubExecutor:
                 params, state, opt = carry
                 step_idx, lr = xs[0], xs[1]
                 feeds = list(xs[2:])
-                outputs, p, s, o, _ = step_fn(params, state, opt, feeds,
-                                              lr, step_idx, rng)
+                outputs, p, s, o, _, h = step_fn(params, state, opt,
+                                                 feeds, lr, step_idx,
+                                                 rng)
                 outs = [v for v, none in zip(outputs, out_is_none)
                         if not none]
-                return (p, s, o), outs
+                # health sentinels stack along the scan axis (None —
+                # an empty pytree — when the monitor is off, so the
+                # disabled program is unchanged)
+                return (p, s, o), (outs, h)
             steps = step0 + jnp.arange(nsteps, dtype=jnp.int32)
-            carry, outs = jax.lax.scan(
+            carry, (outs, health) = jax.lax.scan(
                 body, (params, state, opt_state),
                 tuple([steps, lrs] + list(feeds_stacked)))
-            return outs, carry[0], carry[1], carry[2]
+            return outs, health, carry[0], carry[1], carry[2]
 
         donate = (0, 1, 2) if self.training else ()
         return jax.jit(block_fn, donate_argnums=donate)
@@ -794,14 +871,21 @@ class SubExecutor:
         fn = self.compiled[key]
         with self.config.telemetry.span("block_dispatch", steps=nsteps,
                                         subgraph=self.name):
-            outs, new_params, new_state, new_opt = fn(
+            outs, health, new_params, new_state, new_opt = fn(
                 executor.params, executor.state, executor.opt_state,
                 feeds, lrs, np.int32(self.step_count), executor.base_rng)
         if self.training:
             executor.params = new_params
             executor.state = new_state
             executor.opt_state = new_opt
+        step0 = self.step_count
         self.step_count += nsteps
+        hm = self.config.health_monitor
+        if hm is not None and health is not None:
+            # sampled steps inside the block check from ONE fetch of
+            # the stacked sentinel pytree (telemetry/health.py)
+            hm.after_block(self, health, step0, nsteps,
+                           runtime=executor.ps_runtime)
         return self._split_block_outputs(outs, nsteps, convert)
 
     def _split_block_outputs(self, outs, nsteps, convert):
@@ -903,7 +987,7 @@ class SubExecutor:
 
         with self.config.telemetry.span("device_dispatch",
                                         subgraph=self.name):
-            outputs, new_params, new_state, new_opt, _ = fn(
+            outputs, new_params, new_state, new_opt, _, health = fn(
                 *self.trace_args(executor, feed_map))
         if self.training:
             executor.params = new_params
@@ -912,6 +996,10 @@ class SubExecutor:
             for opt in self.optimizer_ops:
                 opt.optimizer.lr_sched.step()
         self.step_count += 1
+        hm = self.config.health_monitor
+        if hm is not None and health is not None:
+            self._last_health = health
+            hm.after_step(self)
 
         results = []
         for out in outputs:
@@ -1455,6 +1543,8 @@ class Executor:
         if self._heartbeat is not None:
             # clean completion: the watchdog stops counting this rank
             self._heartbeat.done()
+        if self.config.health_monitor is not None:
+            self.config.health_monitor.close()
         self.config.telemetry.flush()
 
     def __del__(self):

@@ -263,7 +263,7 @@ def run_preflight(cfg, command):
 
 
 def launch_command(cfg, command, identify=None, telemetry=None,
-                   hang_timeout=None):
+                   hang_timeout=None, health=None):
     """Run ``command`` once per worker with the cluster env wired
     (the ``heturun -c conf.yml python train.py`` path).
 
@@ -273,6 +273,16 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     a Prometheus ``/metrics`` scrape (HETU_TELEMETRY_PORT), and after
     the workers exit the launcher merges the per-rank traces into ONE
     Perfetto-loadable ``trace_merged.json``.
+
+    ``health`` (a HealthOptions spec string, from ``--health``) arms
+    the training health monitor fleet-wide: every worker's executors
+    resolve ``Executor(health_options=None)`` from the exported
+    ``HETU_HEALTH``, write per-rank ``health_rank<r>.jsonl`` files into
+    the telemetry dir, and trip the configured action ladder on
+    nonfinite values / grad spikes / staleness violations
+    (telemetry/health.py). Implies telemetry (the health doctor needs a
+    directory to merge) — a temp dir is created when ``--telemetry``
+    was not given.
 
     ``hang_timeout`` (seconds, from ``--hang-timeout``) arms the fleet
     watchdog: workers heartbeat per step into the telemetry dir
@@ -291,6 +301,11 @@ def launch_command(cfg, command, identify=None, telemetry=None,
         telemetry = tempfile.mkdtemp(prefix="hetu-watchdog-")
         print(f"watchdog: --hang-timeout without --telemetry; black-box "
               f"dumps go to {telemetry}")
+    if health and not telemetry:
+        import tempfile
+        telemetry = tempfile.mkdtemp(prefix="hetu-health-")
+        print(f"health: --health without --telemetry; health records "
+              f"go to {telemetry}")
     if telemetry:
         tdir = os.path.abspath(telemetry)
         os.makedirs(tdir, exist_ok=True)
@@ -308,6 +323,9 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     ps_env = _ps_env(cfg, endpoints)
     if tdir:
         ps_env["HETU_TELEMETRY"] = tdir
+    if health:
+        # every worker's Executor resolves health_options from the env
+        ps_env["HETU_HEALTH"] = str(health)
     if hang_timeout:
         ps_env["HETU_WATCHDOG_DIR"] = tdir
         ps_env["HETU_HANG_TIMEOUT"] = str(float(hang_timeout))
@@ -408,14 +426,17 @@ def _wait_with_watchdog(workers, tdir, hang_timeout):
 
 
 def _clear_stale_blackbox(tdir):
-    """Drop a previous fleet's heartbeats / flight dumps / stack logs
-    from a reused --telemetry dir. A stale hb_rank*.json with an old
-    timestamp would false-fire the watchdog on the brand-new healthy
-    fleet within its first poll, and stale flight dumps would pollute
-    the new run's blackbox report."""
+    """Drop a previous fleet's heartbeats / flight dumps / stack logs /
+    health records from a reused --telemetry dir. A stale hb_rank*.json
+    with an old timestamp would false-fire the watchdog on the
+    brand-new healthy fleet within its first poll, stale flight dumps
+    would pollute the new run's blackbox report, and health_rank*.jsonl
+    is append-mode — a reused dir would merge two runs' step
+    numbering in the divergence doctor."""
     import glob as _glob
     for pat in ("hb_rank*.json", "flight_rank*.json", "stacks_*.log",
-                "oom_rank*.txt"):
+                "oom_rank*.txt", "health_rank*.jsonl",
+                "health_lastgood_rank*.json"):
         for path in _glob.glob(os.path.join(tdir, pat)):
             try:
                 os.remove(path)
@@ -516,6 +537,15 @@ def main(argv=None):
                              "findings, and exit WITHOUT spawning "
                              "PS servers or workers (exit 0 clean, "
                              "121 on errors)")
+    parser.add_argument("--health", default=None, metavar="SPEC",
+                        help="arm the training health monitor fleet-"
+                             "wide (exports HETU_HEALTH=SPEC): device-"
+                             "side numerics sentinels + staleness "
+                             "telemetry per rank, health_rank<r>.jsonl "
+                             "under the telemetry dir, trip ladder per "
+                             "SPEC (e.g. '1' or "
+                             "'every_n=5,action=dump'); post-mortem "
+                             "with python -m hetu_tpu.telemetry.health")
     parser.add_argument("--hang-timeout", type=float, default=None,
                         metavar="SECONDS",
                         help="arm the fleet watchdog: when any rank's "
@@ -537,7 +567,8 @@ def main(argv=None):
         return run_preflight(cfg, args.command)
     return launch_command(cfg, args.command, args.identify,
                           telemetry=args.telemetry,
-                          hang_timeout=args.hang_timeout)
+                          hang_timeout=args.hang_timeout,
+                          health=args.health)
 
 
 if __name__ == "__main__":

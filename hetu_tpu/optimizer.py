@@ -22,7 +22,30 @@ from .ndarray import IndexedSlices
 from .ops.variable import PlaceholderOp
 
 __all__ = ["Optimizer", "OptimizerOp", "SGDOptimizer", "MomentumOptimizer",
-           "AdaGradOptimizer", "AdamOptimizer", "AdamWOptimizer"]
+           "AdaGradOptimizer", "AdamOptimizer", "AdamWOptimizer",
+           "sentinel_stats"]
+
+
+def sentinel_stats(param, grad, new_param):
+    """Device-side health sentinels for one parameter (telemetry/
+    health.py): gradient global-norm, nonfinite element count, and
+    update/weight ratio — three scalar reductions fused into the
+    compiled step, fetched by the monitor at cadence. ``param`` /
+    ``new_param`` may be None (PS-pushed grads have no worker-side
+    update); the ratio reports 0 there."""
+    vals = grad.values if isinstance(grad, IndexedSlices) else grad
+    vals32 = vals.astype(jnp.float32)
+    grad_norm = jnp.sqrt(jnp.sum(jnp.square(vals32)))
+    nonfinite = jnp.sum(~jnp.isfinite(vals32)).astype(jnp.int32)
+    if param is None or new_param is None:
+        ratio = jnp.zeros((), jnp.float32)
+    else:
+        p32 = param.astype(jnp.float32)
+        upd = jnp.sqrt(jnp.sum(jnp.square(
+            new_param.astype(jnp.float32) - p32)))
+        ratio = upd / (jnp.sqrt(jnp.sum(jnp.square(p32))) + 1e-12)
+    return {"grad_norm": grad_norm, "nonfinite": nonfinite,
+            "update_ratio": ratio}
 
 
 class Optimizer:
@@ -296,6 +319,14 @@ class OptimizerOp(Op):
             lr = opt.learning_rate
         new_params, new_state = opt.update(
             param_vals, grad_vals, ectx.opt_state or {}, lr, ectx.step)
+        sentinels = getattr(ectx, "health_sentinels", None)
+        if sentinels is not None:
+            # training health monitor: per-layer grad norm / nonfinite
+            # count / update ratio, captured at trace time and returned
+            # from the step as one auxiliary pytree (telemetry/health)
+            for node, pval in param_vals.items():
+                sentinels.append((node.name, sentinel_stats(
+                    pval, grad_vals[node], new_params.get(node, pval))))
         ectx.new_params.update(new_params)
         ectx.new_opt_state = {**(ectx.opt_state or {}), **new_state}
         return jnp.zeros((1,), dtype=jnp.float32)

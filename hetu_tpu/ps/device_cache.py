@@ -34,6 +34,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import health as _health
+
 
 # -- device-side helpers (shape-bucketed so jit cache stays small) ---------
 
@@ -92,6 +94,11 @@ class DeviceCacheTable:
         self.pull_bound = int(pull_bound)
         self.nworkers = int(nworkers)
         self.drain_compress = bool(drain_compress)
+        # owning executor's health monitor (stamped by the PS runtime
+        # at registration) — scopes staleness observations so two
+        # executors in one process never cross-attribute; None falls
+        # back to the module broadcast (single-executor processes)
+        self.health_monitor = None
 
         # id -> slot map: direct-indexed for tables that fit, dict above
         # (a 33.7M-row Criteo map is a 135MB int32 array; a trillion-row
@@ -304,6 +311,15 @@ class DeviceCacheTable:
         if not n_ref:
             return None, None
         pos = np.nonzero(vers != self.ver[uniq_slots])[0]
+        if len(pos) and (self.health_monitor is not None
+                         or _health.active()):
+            # observed read staleness: how many server updates each
+            # refreshed row actually ran behind before SyncEmbedding
+            # caught it up — the paper's consistency knob, measured
+            # (telemetry/health.py; pull_bound is the configured bound)
+            _health.observe_staleness(
+                "pull", self.tid, vers[pos] - self.ver[uniq_slots][pos],
+                self.pull_bound, monitor=self.health_monitor)
         self.ver[uniq_slots[pos]] = vers[pos]
         self.pulled_rows += len(pos)
         return uniq_slots[pos], out[pos]
@@ -319,6 +335,16 @@ class DeviceCacheTable:
         self.upd[slots] = 0
         self.steps_since_drain = 0
         keep = ids >= 0
+        if keep.any() and (self.health_monitor is not None
+                           or _health.active()):
+            # observed write staleness: per-row local updates the
+            # server had not seen when this drain claimed them. A count
+            # past push_bound means the drain cadence failed to hold
+            # the configured bound (deferred drains, long scan blocks)
+            # — the health monitor trips on those (kind="staleness")
+            _health.observe_staleness("push", self.tid, upds[keep],
+                                      self.push_bound,
+                                      monitor=self.health_monitor)
         return slots[keep].astype(np.int64), ids[keep], upds[keep]
 
     def invalidate(self):

@@ -199,11 +199,15 @@ class PSRuntime:
             if self.config.cstable_policy:
                 from ..cstable import CacheSparseTable
                 bound = self.config.cache_bound
-                self.caches[param.id] = CacheSparseTable(
+                cache = CacheSparseTable(
                     tid, shape[0], int(np.prod(shape[1:])),
                     limit=max(1, shape[0] // 5),
                     policy=self.config.cstable_policy,
                     pull_bound=bound, push_bound=bound)
+                # scope staleness observations to this executor's
+                # monitor (telemetry/health.py)
+                cache.health_monitor = self.config.health_monitor
+                self.caches[param.id] = cache
         else:
             self.client.init_tensor(tid, shape, kind=0, opt=opt_name,
                                     lrs=lrs)
@@ -244,6 +248,8 @@ class PSRuntime:
             pull_bound=self.config.cache_bound,
             nworkers=max(1, self.client.nworkers),
             drain_compress=getattr(self.config, "drain_compress", False))
+        # scope staleness observations to this executor's monitor
+        rt.health_monitor = self.config.health_monitor
         rt._drain_future = None
         self.device_tables[tbl.id] = rt
         self.registered.add(tbl.id)
@@ -336,9 +342,12 @@ class PSRuntime:
         # async dispatches (data dependency orders them before the step)
         note = []
         tel = self.config.telemetry
+        hm = self.config.health_monitor
         for rt, ids_node, slots_node in cached:
             with self._phase("slot_assign"):
                 ids = host_ids(ids_node, "device-cached lookup")
+                if hm is not None:
+                    hm.observe_ids(rt.tid, ids)   # hot-key skew
                 slots, miss_ids, miss_slots, uniq_slots = rt.assign(
                     ids, functools.partial(self._drain_device_table, rt,
                                            wait=True))
@@ -385,6 +394,8 @@ class PSRuntime:
                 continue
             with self._phase("host_pull"):
                 idx = host_ids(lk.inputs[1], "embedding lookup")
+                if hm is not None:
+                    hm.observe_ids(lk.inputs[0].id, idx)
                 width = int(lk.inputs[0].shape[-1])
                 cache = self.caches.get(lk.inputs[0].id)
                 if cache is not None:
@@ -404,6 +415,8 @@ class PSRuntime:
                                                       dirty)
                 continue
             idx = host_ids(op.inputs[0], "sparse pull")
+            if hm is not None:
+                hm.observe_ids(op.parameter.id, idx)
             width = int(op.parameter.shape[-1])
             rows = client.sparse_pull(op.parameter.id, idx, width)
             feed_map[op] = jax.device_put(rows)
@@ -417,8 +430,8 @@ class PSRuntime:
                     sub.compiled[key] = sub._compile_step(
                         sub.trace_args(executor, feed_map))
             fn = sub.compiled[key]
-            outputs, new_params, new_state, new_opt, ps_grads = fn(
-                *sub.trace_args(executor, feed_map))
+            outputs, new_params, new_state, new_opt, ps_grads, health \
+                = fn(*sub.trace_args(executor, feed_map))
             if sub.training:
                 executor.params = new_params
                 executor.state = new_state
@@ -506,6 +519,14 @@ class PSRuntime:
             self._pending_push[0].result()   # bound the pipeline depth
             self._drain_done()
 
+        if hm is not None and health is not None:
+            # after the pushes/barrier so a `raise`-ladder trip never
+            # leaves this step's server updates half-applied; the
+            # monitor also folds in this runtime's staleness/hot-key
+            # observations and samples server-side table stats
+            sub._last_health = health
+            hm.after_step(sub, runtime=self)
+
         results = []
         from .. import ndarray as nd
         for out in outputs:
@@ -568,6 +589,9 @@ class PSRuntime:
     def _spec_pull(self, tid, idx, width):
         """One speculative SparsePull (dedup'd), plus everything needed
         to revalidate and reassemble it at consumption time."""
+        hm = self.config.health_monitor
+        if hm is not None:
+            hm.observe_ids(tid, idx)     # hot-key skew (worker thread)
         with self._phase("prefetch"):
             uniq, inv = np.unique(idx.ravel(), return_inverse=True)
             rows = self.client.sparse_pull(tid, uniq, width)
@@ -810,14 +834,18 @@ class PSRuntime:
 
         note = []
         tel = self.config.telemetry
+        hm = self.config.health_monitor
         for rt, ids_node, slots_node in cached:
             # one vectorized assignment for the whole block: the scan
             # threads a single cache array, so the residency set equals
             # per-step assigns with pins held — see assign_block()
             with self._phase("slot_assign"):
+                ids_stacked = np.stack(ids_block[ids_node])
+                if hm is not None:
+                    hm.observe_ids(rt.tid, ids_stacked)
                 slots_full, miss_ids, miss_slots, uniq_slots, counts = \
                     rt.assign_block(
-                        np.stack(ids_block[ids_node]),
+                        ids_stacked,
                         functools.partial(self._drain_device_table, rt,
                                           wait=True))
             if len(miss_ids):

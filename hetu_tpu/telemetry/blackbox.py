@@ -17,7 +17,12 @@ rerun:
 * **pending operations** — events enqueued but never completed (a
   ``p2p_recv`` stuck waiting on a peer names that peer);
 * **last completed step per rank** — the MegaScale-style straggler
-  view.
+  view;
+* **training health** — when the run's health monitor left
+  ``health_rank<r>.jsonl`` files (telemetry/health.py), the verdict
+  also names the first bad step and the tripped layer/table, so a
+  post-mortem on a health-tripped run reads as one story: which rank
+  died AND where the numerics first went wrong.
 
 Exit codes: 0 = report produced, 2 = nothing to analyze.
 """
@@ -139,11 +144,22 @@ def analyze(tdir):
             lag = min(steps.values())
             suspects = sorted(r for r, s in steps.items() if s == lag)
 
+    # -- training health (health_rank<r>.jsonl, when present) ------------
+    health = None
+    try:
+        from . import health as _health
+        health = _health.summarize_for_blackbox(tdir)
+    except Exception:           # noqa: BLE001 — augmentation only
+        health = None
+    if not suspects and health and health.get("bad_ranks"):
+        suspects = list(health["bad_ranks"])
+
     return {"dir": tdir,
             "ranks": {str(r): info for r, info in ranks.items()},
             "dead_ranks": dead,
             "divergence": divergence,
             "waited_on_ranks": waited_on,
+            "health": health,
             "suspect_ranks": suspects}
 
 
@@ -184,6 +200,25 @@ def format_report(rep):
     if rep["dead_ranks"]:
         lines.append(f"  DEAD rank(s): {rep['dead_ranks']} — no flight "
                      f"dump; killed before any handler could run")
+    health = rep.get("health")
+    if health:
+        if health.get("healthy"):
+            lines.append(
+                f"  HEALTH: no trips through step {health['last_step']}"
+                f" (loss_finite="
+                f"{str(health.get('loss_finite')).lower()})")
+        else:
+            what = ", ".join(health.get("trip_kinds") or []) or "trip"
+            where = ""
+            if health.get("layer"):
+                where += f" layer {health['layer']!r}"
+            if health.get("table"):
+                where += f" table {health['table']}"
+            lines.append(
+                f"  HEALTH: first bad step {health['first_bad_step']} "
+                f"on rank {health['bad_rank']} ({what}{where}) — "
+                f"`python -m hetu_tpu.telemetry.health {rep['dir']}` "
+                f"for the ranked causes")
     if rep["suspect_ranks"]:
         lines.append(f"  SUSPECT rank(s): {rep['suspect_ranks']}")
     else:

@@ -96,6 +96,13 @@ SPAN_SCHEMA = {
     "cpp_dispatch": {"ticks": _req(_INT), "fill": _opt(_INT),
                      "drain": _opt(_INT), "fuse_ticks": _opt(_INT),
                      "stages": _opt(_INT), "microbatches": _opt(_INT)},
+    # training health monitor (telemetry/health.py): one "health" span
+    # per sampled check, one "health_trip" instant per ladder firing
+    "health": {"step": _req(_INT), "layers": _opt(_INT),
+               "trips": _opt(_INT)},
+    "health_trip": {"step": _req(_INT), "kind": _req(_STR),
+                    "layer": _opt(_STR), "table": _opt(_STR),
+                    "value": _opt(_NUM), "limit": _opt(_NUM)},
     # autotuner / probe (tune/)
     "autotune_sweep": {"kernel": _req(_STR), "key": _req(_STR),
                        "chosen": _req(_STR), "picked_ms": _req(_NUM),

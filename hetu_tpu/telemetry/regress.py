@@ -32,6 +32,12 @@ _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 # — HIGHER is better; ingest_wait_ms is device-waited-on-host — lower.
 _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True}
 
+# informational per-record fields (the health monitor's stamps,
+# telemetry/health.py): reported so a reviewer sees a NaN run on its
+# face, but NEVER direction-compared — a loss_finite flip is a broken
+# run to investigate, not a perf ratio
+_INFORMATIONAL_FIELDS = ("loss_finite", "grad_norm_final")
+
 
 def _metric_lines(text):
     out = {}
@@ -130,6 +136,10 @@ def compare(old, new, tolerance):
             else:
                 fs = "ok"
             rows.append((f"{name}.{field}", fo, fn, fr, fs))
+        for field in _INFORMATIONAL_FIELDS:
+            if field in o or field in n:
+                rows.append((f"{name}.{field}", o.get(field),
+                             n.get(field), None, "info"))
     return rows
 
 
@@ -283,6 +293,9 @@ def main(argv=None):
     rows = compare(old, new, args.tolerance)
     regressed = 0
     for name, ov, nv, ratio, status in rows:
+        if status == "info":
+            print(f"{status:>10}  {name}  {ov} -> {nv}")
+            continue
         if status in ("new", "removed", "skipped"):
             print(f"{status:>10}  {name}")
             continue

@@ -50,7 +50,8 @@ def pytest_runtest_makereport(item, call):
     import glob
     import shutil
     patterns = ("trace_*.json", "flight_rank*.json", "hb_rank*.json",
-                "stacks_*.log", "metrics_rank*.jsonl", "oom_rank*.txt")
+                "stacks_*.log", "metrics_rank*.jsonl", "oom_rank*.txt",
+                "health_rank*.jsonl", "health_lastgood_rank*.json")
     found = []
     for pat in patterns:
         found += glob.glob(os.path.join(str(tmp), "**", pat),

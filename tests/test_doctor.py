@@ -366,6 +366,7 @@ def _producer_fixture_tracer():
     span("cpp_dispatch", ticks=5, fill=1, drain=1, fuse_ticks=2,
          stages=2, microbatches=4)
     span("cpp_pack_feeds", bytes=512)
+    span("health", step=10, layers=3, trips=1)
     span("autotune_sweep", kernel="flash_fwd", key="cpu|flash|128",
          chosen="(128, 128)", picked_ms=1.2,
          candidates_ms={"(128, 128)": 1.2, "(256, 256)": None})
@@ -374,6 +375,10 @@ def _producer_fixture_tracer():
     tr.instant("h2d_stacked", bytes=4096, overlapped=False)
     tr.instant("memory_analysis", label="default", arg_bytes=1)
     tr.instant("step_logged", step=1, wall_ms=2.5)
+    tr.instant("health_trip", step=10, kind="nonfinite", layer="w1",
+               value=3.0, limit=0)
+    tr.instant("health_trip", step=20, kind="staleness", table="7",
+               value=9.0, limit=4.0)
     return tr
 
 
