@@ -60,13 +60,15 @@ def _schedule_of(config):
     if getattr(config, "pipeline_mode", None) == "collective":
         return "collective"
     if getattr(config, "use_pipedream", False):
-        return "1f1b"
+        v = (getattr(config, "pp_options", None) or {}).get(
+            "virtual_stages", 1) or 1
+        return "interleaved_1f1b" if int(v) > 1 else "1f1b"
     return "gpipe"
 
 
 def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
             nprocs=None, num_microbatches=None, hbm_budget=None,
-            extra_roots=(), frozen=False):
+            extra_roots=(), frozen=False, virtual_stages=None):
     """Run every static pass over a graph; returns a :class:`Report`.
 
     ``config`` (a HetuConfig) refines the passes — pipeline schedule
@@ -83,6 +85,9 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
         schedule = schedule or _schedule_of(config)
         num_microbatches = (num_microbatches
                             or getattr(config, "num_microbatches", None))
+        if virtual_stages is None:
+            virtual_stages = (getattr(config, "pp_options", None)
+                              or {}).get("virtual_stages")
 
     def _guard(name, fn, *a, **kw):
         try:
@@ -101,7 +106,8 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
     _guard("sharding", sharding_pass, topo, report, shapes=shapes)
     _guard("deadlock", deadlock_pass, eval_node_list, report,
            schedule=schedule or "gpipe", nprocs=nprocs,
-           num_microbatches=num_microbatches)
+           num_microbatches=num_microbatches,
+           virtual_stages=virtual_stages)
     _guard("memory", memory_pass, topo, shapes, report,
            budget=hbm_budget)
     _guard("overlap", overlap_pass, topo, report, config=config)

@@ -25,6 +25,17 @@ HT306  collective-pipeline contract violation (non-linear
        chain, loss off the last stage, ...)                     error
 HT307  stage consumes a boundary produced by a LATER stage on
        the same rank (forward schedule order violation)         error
+HT308  interleaved (virtual-stage) schedule without round-robin
+       stage ownership — the runtime refuses it at construction
+       and the bubble reduction is forfeited                    error
+
+The ``interleaved_1f1b`` schedule (``pp_options virtual_stages > 1``,
+stages placed round-robin so each rank owns V chunks) executes the
+SAME per-microbatch 1F1B driver — its event programs are the 1f1b
+replay over the interleaved ownership map (the channel's buffered
+sends + blocking recvs realize the chunk interleaving at run time), so
+HT301/302/305 coverage extends to it unchanged; HT308 is the
+placement-shape check specific to it.
 """
 from __future__ import annotations
 
@@ -32,8 +43,8 @@ import os
 from collections import Counter
 
 __all__ = ["build_plan", "rank_programs", "simulate",
-           "collective_order_pass", "deadlock_pass", "Event",
-           "PipelinePlan"]
+           "collective_order_pass", "interleaved_placement_pass",
+           "deadlock_pass", "Event", "PipelinePlan"]
 
 
 class Event:
@@ -52,13 +63,14 @@ class Event:
 
 
 class _Stage:
-    __slots__ = ("index", "owner", "hostname", "nodes", "in_nodes",
-                 "out_nodes", "consumed_outs")
+    __slots__ = ("index", "owner", "hostname", "device_id", "nodes",
+                 "in_nodes", "out_nodes", "consumed_outs")
 
-    def __init__(self, index, hostname):
+    def __init__(self, index, hostname, device_id=0):
         self.index = index
         self.owner = 0
         self.hostname = hostname
+        self.device_id = device_id
         self.nodes = []
         self.in_nodes = []
         self.out_nodes = []
@@ -104,7 +116,8 @@ def build_plan(eval_nodes, nprocs=None):
     if len(keys) < 2:
         return None
     key_to_stage = {k: i for i, k in enumerate(keys)}
-    stages = [_Stage(i, k[0][0]) for i, k in enumerate(keys)]
+    stages = [_Stage(i, k[0][0], device_id=k[0][1])
+              for i, k in enumerate(keys)]
 
     assign = {}
     for node in topo:
@@ -241,10 +254,39 @@ def rank_programs(plan, schedule="gpipe", num_microbatches=None,
     if schedule == "gpipe":
         _fwd_events(plan, progs, report)
         _bwd_events(plan, progs)
-    else:                               # 1f1b — replay the real driver
+    else:        # 1f1b / interleaved_1f1b — replay the real driver
         _drive_1f1b(lambda m: _fwd_events(plan, progs, report, m=m),
                     lambda m: _bwd_events(plan, progs, m=m), S, M)
     return progs
+
+
+def interleaved_placement_pass(plan, report, virtual_stages=None):
+    """HT308: a schedule declared interleaved (virtual_stages > 1)
+    must place stages round-robin over the ranks — stage i on rank
+    i mod nranks, V = nstages/nranks chunks per rank (what
+    ``pipeline.virtual_stage_program`` models). The multiproc runtime
+    REFUSES this configuration (``PipelineSubExecutor`` raises
+    ``ValueError`` on non-round-robin ownership under virtual_stages),
+    so the static form is an error: a preflight that passed it would
+    approve a launch that dies on every rank at construction."""
+    owners = [s.owner for s in plan.stages]
+    ranks = sorted(set(owners))
+    nr = len(ranks)
+    S = len(owners)
+    ok = (nr > 0 and S % nr == 0
+          and all(o == owners[i % nr] for i, o in enumerate(owners)))
+    v = S // nr if nr else 1
+    if not ok:
+        report.add(
+            "HT308", "error",
+            f"interleaved schedule (virtual_stages="
+            f"{virtual_stages or v}) without round-robin placement: "
+            f"stage owners are {owners}, expected stage i on rank "
+            f"i mod {nr} — the pipeline executor refuses this at "
+            f"construction (and consecutive chunks on one rank would "
+            f"forfeit the ~1/V bubble reduction anyway); cycle the "
+            f"worker contexts V times")
+    return ok
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +380,36 @@ def collective_order_pass(programs, report):
             f"fleet deadlocks at the first mismatched collective")
 
 
+def _collective_interleaved_pass(plan, report, virtual_stages):
+    """HT308, collective form: virtual_stages=V folds S·V stages onto
+    S devices, so the stage contexts' device ids must repeat
+    round-robin (stage i on device i % S_dev, first S_dev distinct) —
+    the exact check ``pipeline._build_collective`` enforces with a
+    ``ValueError`` at first dispatch; here it refuses the launch
+    statically instead."""
+    V = int(virtual_stages)
+    S = len(plan.stages)
+    devs = [s.device_id for s in plan.stages]
+    if S % V != 0:
+        report.add(
+            "HT308", "error",
+            f"interleaved collective pipeline: virtual_stages={V} "
+            f"must divide the stage count {S}")
+        return False
+    s_dev = S // V
+    if len(set(devs[:s_dev])) != s_dev or any(
+            devs[i] != devs[i % s_dev] for i in range(S)):
+        report.add(
+            "HT308", "error",
+            f"interleaved collective pipeline (virtual_stages={V}) "
+            f"needs round-robin placement: stage i on device "
+            f"i % {s_dev}, got devices {devs} — the collective "
+            f"builder refuses this at first dispatch; cycle the "
+            f"ht.context(...) device list V times")
+        return False
+    return True
+
+
 def _collective_chain_pass(plan, report):
     """Static form of CollectiveGPipe's linear-chain contract (the
     builder raises at trace time; preflight reports before launch)."""
@@ -368,7 +440,7 @@ def _collective_chain_pass(plan, report):
 
 
 def deadlock_pass(eval_nodes, report, schedule="gpipe", nprocs=None,
-                  num_microbatches=None):
+                  num_microbatches=None, virtual_stages=None):
     """Full pass: marker pairing, staging, per-schedule symbolic run."""
     from ..graph.autodiff import find_topo_sort
     from ..ops.comm import PipelineReceiveOp, PipelineSendOp
@@ -392,7 +464,15 @@ def deadlock_pass(eval_nodes, report, schedule="gpipe", nprocs=None,
         return None
     if schedule == "collective":
         _collective_chain_pass(plan, report)
+        if virtual_stages and virtual_stages > 1:
+            _collective_interleaved_pass(plan, report, virtual_stages)
         return plan
+    if schedule == "interleaved_1f1b" or (virtual_stages
+                                          and virtual_stages > 1):
+        if plan.nranks > 1:
+            interleaved_placement_pass(plan, report,
+                                       virtual_stages=virtual_stages)
+        schedule = "1f1b"       # same driver: replay its event order
     programs = rank_programs(plan, schedule=schedule,
                              num_microbatches=num_microbatches,
                              report=report)

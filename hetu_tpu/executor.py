@@ -111,13 +111,82 @@ class HetuConfig:
                  dtype=None, num_microbatches=None, drain_compress=False,
                  pipeline_mode=None, pp_options=None, telemetry=None,
                  validate=None, overlap_options=None,
-                 health_options=None):
+                 health_options=None, parallel=None, rules=None,
+                 autoplan_options=None):
         maybe_init_distributed()
         # unified runtime telemetry (span tracer + metrics registry):
         # None resolves to the env-driven process default (enabled when
         # heturun --telemetry exported HETU_TELEMETRY), so launcher-run
         # scripts trace without code changes; see hetu_tpu/telemetry
         self.telemetry = _telemetry.resolve(telemetry)
+        # -- cost-model auto-parallelism (parallel/autoplan.py) ----------
+        # parallel="auto" + a declarative rules table replaces hand
+        # Dispatch specs/stage contexts: the planner enumerates
+        # (dp, tp, pp) candidates, scores them on the measured CostDB,
+        # applies the argmin (Dispatch splices + stage contexts) and
+        # overrides the pipeline kwargs below. HETU_AUTOPLAN_REPORT
+        # (the `heturun --autoplan` contract) prints the predicted-vs-
+        # measured table and exits before any fleet machinery, exactly
+        # like HETU_PREFLIGHT.
+        if parallel not in (None, "auto"):
+            raise ValueError(
+                f"unknown parallel={parallel!r}; expected 'auto' (cost-"
+                "model planner, see docs/parallelism.md) or None")
+        self.autoplan = None
+        self.rules = rules
+        autoplan_report = os.environ.get("HETU_AUTOPLAN_REPORT")
+        if parallel == "auto" or autoplan_report is not None:
+            from .parallel import autoplan as _autoplan
+            ap_opts = dict(autoplan_options or {})
+            result = _autoplan.choose_plan(
+                eval_node_list, rules=rules,
+                num_microbatches=num_microbatches,
+                model=ap_opts.pop("model", train_name), **ap_opts)
+            self.autoplan = result
+            if autoplan_report is not None:
+                import json as _json
+                import sys as _sys
+                print(result.render(), file=_sys.stderr)
+                if autoplan_report not in ("1", "true"):
+                    try:
+                        os.makedirs(os.path.dirname(
+                            os.path.abspath(autoplan_report)),
+                            exist_ok=True)
+                        with open(autoplan_report, "w") as f:
+                            _json.dump(result.to_dict(), f, indent=1)
+                            f.write("\n")
+                    except OSError as e:
+                        print(f"autoplan: could not write "
+                              f"{autoplan_report}: {e}",
+                              file=_sys.stderr)
+                print("autoplan: OK")
+                raise SystemExit(0)
+            overrides = _autoplan.apply_plan(eval_node_list, result.plan,
+                                             info=result.info)
+            gpipe = overrides.get("gpipe", gpipe)
+            pipedream = overrides.get("pipedream", pipedream)
+            pipeline_mode = overrides.get("pipeline_mode",
+                                          pipeline_mode)
+            if "num_microbatches" in overrides:
+                num_microbatches = overrides["num_microbatches"]
+            if "pp_options" in overrides:
+                pp_options = {**(pp_options or {}),
+                              **overrides["pp_options"]}
+            # dp: realized in-process as a dp mesh over the first dp
+            # local devices (batch shards on dp, gradients reduce
+            # implicitly in the SPMD program — the test_parallel dp
+            # idiom); multi-process dp keeps the launcher fleet path
+            self._autoplan_dp = result.plan.dp
+            if result.plan.dp > 1 and result.plan.pp == 1 and \
+                    mesh is None:
+                try:
+                    devs = jax.devices()
+                except RuntimeError:
+                    devs = []
+                if len(devs) >= result.plan.dp:
+                    from jax.sharding import Mesh as _Mesh
+                    mesh = _Mesh(np.asarray(devs[:result.plan.dp]),
+                                 axis_names=("dp",))
         self.eval_node_list = eval_node_list
         self.train_name = train_name
         self.val_name = val_name
@@ -196,6 +265,10 @@ class HetuConfig:
             elif launch_mpi:
                 self.comm_mode = "AllReduce"
         self.nrank = max(1, self.context.worker_num)
+        if getattr(self, "_autoplan_dp", 1) > 1 and mesh is not None \
+                and "dp" in getattr(mesh, "axis_names", ()):
+            # the auto-built dp mesh: nrank is the batch-shard count
+            self.nrank = max(self.nrank, self._autoplan_dp)
         self.rank = 0                 # single-controller SPMD
         self.ps_nodes = []
         self.spmd_axis = None         # set inside shard_map tracing only

@@ -36,7 +36,8 @@ import subprocess
 import sys
 import time
 
-__all__ = ["parse_config", "launch", "launch_command", "main"]
+__all__ = ["parse_config", "launch", "launch_command", "run_autoplan",
+           "main"]
 
 _procs = []
 
@@ -247,7 +248,7 @@ def run_preflight(cfg, command):
     if hosts_in_order:
         env["HETU_HOSTS"] = ",".join(hosts_in_order)
     for stale in ("HETU_COORDINATOR", "HETU_PS_HOSTS", "HETU_PS_PORTS",
-                  "HETU_PROC_ID"):
+                  "HETU_PROC_ID", "HETU_AUTOPLAN_REPORT"):
         env.pop(stale, None)
     p = subprocess.run(command, env=env)
     if p.returncode == 0:
@@ -259,6 +260,46 @@ def run_preflight(cfg, command):
             # constructing an Executor — nothing was actually verified
             print("preflight: WARNING script exited 0 but never built a "
                   "graph (no Executor constructed); nothing was verified")
+    return p.returncode
+
+
+def run_autoplan(cfg, command):
+    """Cost-model plan preview (``heturun --autoplan``): run ``command``
+    ONCE in a plain subprocess with ``HETU_AUTOPLAN_REPORT`` set — the
+    executor's config hook (executor.py) runs the auto-parallelism
+    planner over the graph the script builds, prints the chosen plan
+    and its predicted-vs-measured cost table, writes the JSON report,
+    and exits before any fleet machinery. Same fleet-env scrubbing as
+    the preflight gate, and the same stage-ownership env so pp plans
+    map hostnames the way the real launch would. Exit 0 = plan
+    printed; anything else = the script crashed before an Executor was
+    built."""
+    import tempfile
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hosts_in_order = []
+    for host, n in cfg.worker_hosts():
+        hosts_in_order.extend([host] * n)
+    report_path = os.path.join(tempfile.mkdtemp(prefix="hetu-autoplan-"),
+                               "autoplan.json")
+    env = {**os.environ,
+           "PYTHONPATH": pkg_root + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "HETU_AUTOPLAN_REPORT": report_path,
+           "HETU_NUM_PROCS": str(max(1, cfg.num_workers))}
+    if hosts_in_order:
+        env["HETU_HOSTS"] = ",".join(hosts_in_order)
+    for stale in ("HETU_COORDINATOR", "HETU_PS_HOSTS", "HETU_PS_PORTS",
+                  "HETU_PROC_ID", "HETU_PREFLIGHT"):
+        env.pop(stale, None)
+    p = subprocess.run(command, env=env)
+    if p.returncode == 0:
+        if os.path.exists(report_path):
+            print(f"autoplan: report written to {report_path}")
+        else:
+            print("autoplan: WARNING script exited 0 but no report "
+                  "file appeared — either the script never built an "
+                  "Executor, or the report path was unwritable (a "
+                  "plan table printed above means the latter)")
     return p.returncode
 
 
@@ -537,6 +578,13 @@ def main(argv=None):
                              "findings, and exit WITHOUT spawning "
                              "PS servers or workers (exit 0 clean, "
                              "121 on errors)")
+    parser.add_argument("--autoplan", action="store_true",
+                        help="cost-model plan preview: run the command "
+                             "once with the auto-parallelism planner "
+                             "armed (HETU_AUTOPLAN_REPORT), print the "
+                             "chosen (dp,tp,pp,M,V) plan and its "
+                             "predicted-vs-measured cost table, and "
+                             "exit WITHOUT spawning the fleet")
     parser.add_argument("--health", default=None, metavar="SPEC",
                         help="arm the training health monitor fleet-"
                              "wide (exports HETU_HEALTH=SPEC): device-"
@@ -565,6 +613,8 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _shutdown)
     if args.preflight:
         return run_preflight(cfg, args.command)
+    if args.autoplan:
+        return run_autoplan(cfg, args.command)
     return launch_command(cfg, args.command, args.identify,
                           telemetry=args.telemetry,
                           hang_timeout=args.hang_timeout,

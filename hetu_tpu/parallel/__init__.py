@@ -7,7 +7,12 @@ milestone:
   * ``planner``  — NodeStatus propagation from ``ht.dispatch`` markers,
                    lowered to PartitionSpec sharding constraints (TP).
   * ``mesh``     — device-mesh construction helpers (dp/tp/pp/sp axes).
-  * ``pipeline`` — GPipe and PipeDream(1F1B) pipeline executors.
+  * ``pipeline`` — GPipe and PipeDream(1F1B) pipeline executors, incl.
+                   the interleaved (virtual-stage) schedule helpers.
+  * ``autoplan`` — cost-model auto-parallelism planner: declarative
+                   rules tables compiled to Dispatch specs, candidate
+                   (dp, tp, pp, M, V) plans scored on the measured
+                   CostDB (``Executor(parallel="auto")``).
   * ``ring``     — ring attention / sequence parallelism (new capability,
                    absent in the reference — SURVEY.md §5).
 """

@@ -55,6 +55,24 @@ tolerance):
   * ``boundary_dtype`` — "bf16" casts the ppermute payload at stage
     boundaries (halving boundary bytes on the wire); compute and the
     loss/gradient/optimizer math stay fp32.
+  * ``virtual_stages=V`` — **interleaved schedule** (Megatron-style
+    virtual stages, the round-10 bubble attack): the user's S*V stage
+    contexts map onto S devices, device r owning chunks
+    ``{v*S + r : v < V}`` stacked as a ``[S, V, ...]`` parameter axis.
+    Each tick computes ONE chunk per device and the boundary rides a
+    full ring ``ppermute`` (the S-1 -> 0 wraparound carries a chunk
+    group transition); chunk ``v*S + r`` of microbatch ``m`` runs at
+    tick ``r + v*M + m``, so the whole schedule is ``V*M + S - 1``
+    ticks of 1/V-stage work each — fill/drain shrinks from ``(S-1)``
+    stage-times to ``(S-1)/V``, i.e. bubble fraction
+    ``(S-1)/(V*M + S - 1)`` vs GPipe's ``(S-1)/(M + S - 1)``. The
+    wraparound arrives ``M - S + 1`` ticks before its consumer turn,
+    buffered in a ``[M-S+1, ...]`` ring carry (read-before-write at
+    slot ``t mod (M-S+1)`` is exactly the needed delay), which is why
+    the schedule requires ``M >= S``. Losses/gradients are identical
+    to GPipe on the same S*V-stage graph (the schedule only reorders
+    work; every microbatch still traverses every stage once and one
+    optimizer step applies the summed gradients).
 """
 from __future__ import annotations
 
@@ -92,14 +110,31 @@ class CollectiveGPipe:
     def __init__(self, branches, boundary_aval, num_microbatches, mesh,
                  axis_name, optimizer, feed_mode="sharded", fuse_ticks=2,
                  unroll_fill_drain=True, boundary_dtype=None,
-                 telemetry=None):
+                 virtual_stages=1, telemetry=None):
         if feed_mode not in ("sharded", "replicated"):
             raise ValueError(
                 f"feed_mode must be 'sharded' or 'replicated', got "
                 f"{feed_mode!r}")
         self.branches = branches
-        self.S = len(branches)
+        self.S = len(branches)          # total chunks (user stages)
         self.M = num_microbatches
+        self.V = max(1, int(virtual_stages or 1))
+        if self.S % self.V != 0:
+            raise ValueError(
+                f"virtual_stages={self.V} must divide the stage count "
+                f"{self.S} (each device owns exactly V chunks)")
+        self.S_dev = self.S // self.V   # devices on the stage axis
+        if self.V > 1:
+            if self.S_dev < 2:
+                raise ValueError(
+                    "interleaved schedule needs >= 2 devices after "
+                    f"folding {self.S} stages by V={self.V}")
+            if self.M < self.S_dev:
+                raise ValueError(
+                    f"interleaved schedule requires M >= device count "
+                    f"({self.M} < {self.S_dev}): the S-1 -> 0 wraparound "
+                    f"buffer depth is M - S + 1; raise num_microbatches "
+                    f"or drop virtual_stages")
         self.mesh = mesh
         self.axis_name = axis_name
         self.optimizer = optimizer
@@ -116,21 +151,34 @@ class CollectiveGPipe:
         self._layout = None       # per stage: [(offset, shape, dtype)]
         self._row_bytes = 1
 
+    @property
+    def n_ticks(self):
+        """Schedule length in ticks (V*M + S_dev - 1; the V=1 case is
+        the classic M + S - 1)."""
+        return self.V * self.M + self.S_dev - 1
+
     # -- stage-sharded feed transport -----------------------------------
     def _build_layout(self, feeds_all):
         """Byte layout of each stage's feed bundle inside its row of the
-        packed ``[S, row_bytes]`` array: per feed, (byte offset, stacked
-        [M, mb, ...] shape, dtype). Offsets are static per stage, so
-        branch s decodes its feeds with static slices + bitcasts."""
-        layout, row_bytes = [], 0
-        for fs in feeds_all:
-            off, stage = 0, []
-            for f in fs:
-                shape = tuple(int(d) for d in f.shape)
-                dt = np.dtype(f.dtype)
-                stage.append((off, shape, dt))
-                off += int(np.prod(shape)) * dt.itemsize
-            layout.append(stage)
+        packed ``[S_dev, row_bytes]`` array: per feed, (byte offset,
+        stacked [M, mb, ...] shape, dtype). Offsets are static per
+        stage, so branch s decodes its feeds with static slices +
+        bitcasts. Under V>1 the V chunks sharing a device concatenate
+        into one row (chunk v*S_dev + r at increasing offsets of row
+        r), so a device still receives only ITS chunks' feed bytes."""
+        layout = [None] * self.S
+        row_bytes = 0
+        for r in range(self.S_dev):
+            off = 0
+            for v in range(self.V):
+                c = v * self.S_dev + r
+                stage = []
+                for f in feeds_all[c]:
+                    shape = tuple(int(d) for d in f.shape)
+                    dt = np.dtype(f.dtype)
+                    stage.append((off, shape, dt))
+                    off += int(np.prod(shape)) * dt.itemsize
+                layout[c] = stage
             row_bytes = max(row_bytes, off)
         self._layout = layout
         self._row_bytes = max(row_bytes, 1)
@@ -148,7 +196,7 @@ class CollectiveGPipe:
         if hit is not None and len(hit[0]) == len(leaves) and \
                 all(a is b for a, b in zip(hit[0], leaves)):
             return hit[1]
-        rows = np.zeros((self.S, self._row_bytes), np.uint8)
+        rows = np.zeros((self.S_dev, self._row_bytes), np.uint8)
         for s, fs in enumerate(feeds_all):
             if len(fs) != len(self._layout[s]):
                 raise ValueError(
@@ -169,7 +217,7 @@ class CollectiveGPipe:
                         f"or rebuild the executor")
                 b = np.ascontiguousarray(np.asarray(f), dtype=dt)
                 b = b.view(np.uint8).ravel()
-                rows[s, off:off + b.size] = b
+                rows[s % self.S_dev, off:off + b.size] = b
         packed = jax.device_put(
             rows, NamedSharding(self.mesh, P(self.axis_name)))
         self._packed_cache = (leaves, packed)
@@ -295,6 +343,107 @@ class CollectiveGPipe:
             schedule_loss)(params_local)
         return loss_part[None], grads_local
 
+    # -- interleaved (virtual-stage) schedule body ----------------------
+    def _body_interleaved(self, params_local, feed_arg, base_rng, step):
+        """The V>1 tick loop (see module docstring): one CHUNK per
+        device per tick, boundary on a full-ring ppermute, the S-1 -> 0
+        wraparound delayed through a [M-S+1] ring buffer in the carry
+        (read slot ``t mod B`` before writing it — the value written
+        there B ticks ago is exactly the chunk-group predecessor the
+        device-0 lane consumes now). Differentiated in-body exactly
+        like ``_body``: the transpose of the ring ppermute is the
+        inverse ring, and the buffer's dynamic-slice transposes to a
+        scatter-add, so cotangents retrace the schedule backwards
+        inside the same compiled program."""
+        axis = self.axis_name
+        M, K, V, S = self.M, self.fuse_ticks, self.V, self.S_dev
+        r = lax.axis_index(axis)
+        if self.feed_mode == "sharded":
+            feed_local = jnp.squeeze(feed_arg, 0)
+        else:
+            feed_local = feed_arg
+        ring = [(i, (i + 1) % S) for i in range(S)]
+        B = M - S + 1                   # wraparound delay (ticks)
+        carry_dt = self.boundary_dtype or self.boundary_aval.dtype
+        x0 = jnp.zeros(self.boundary_aval.shape, carry_dt)
+        wbuf0 = jnp.zeros((B,) + tuple(self.boundary_aval.shape),
+                          carry_dt)
+        loss0 = jnp.float32(0.0)
+        if hasattr(lax, "pvary"):
+            x0 = lax.pvary(x0, (axis,))
+            wbuf0 = lax.pvary(wbuf0, (axis,))
+            loss0 = lax.pvary(loss0, (axis,))
+
+        if self.feed_mode == "sharded":
+            def chunk_call(c):
+                br = self.branches[c]
+                v = c // S              # static per branch
+
+                def call(pstack, x, words, mc, rng):
+                    plist = [p[v] for p in pstack]
+                    return br(plist, x,
+                              self._decode_feeds(words, c, mc), rng)
+                return call
+        else:
+            def chunk_call(c):
+                br = self.branches[c]
+                v = c // S
+
+                def call(pstack, x, feeds_all, mc, rng):
+                    plist = [p[v] for p in pstack]
+                    feeds = [jnp.take(f, mc, axis=0)
+                             for f in feeds_all[c]]
+                    return br(plist, x, feeds, rng)
+                return call
+        wrapped = [chunk_call(c) for c in range(self.S)]
+
+        def schedule_loss(params_loc):
+            # local leaves are [1, V, ...]: drop the stage-axis slice
+            pstack = [jnp.squeeze(p, 0) for p in params_loc]
+
+            def tick(carry, t):
+                x_dir, wbuf, loss_acc = carry
+                u = t - r
+                vc = jnp.clip(u // M, 0, V - 1)
+                mc = jnp.clip(u - vc * M, 0, M - 1)
+                rng = jax.random.fold_in(base_rng, step * 131 + mc)
+                slot = jnp.mod(t, B)
+                # read BEFORE this tick's write: the slot holds the
+                # value received B ticks ago — the device-0 lane's
+                # chunk-group predecessor output
+                x_wrap = lax.dynamic_index_in_dim(wbuf, slot, 0,
+                                                  keepdims=False)
+                x_in = jnp.where(r == 0, x_wrap, x_dir)
+                xin = x_in.astype(self.boundary_aval.dtype)
+                c = vc * S + r
+                y, loss = lax.switch(c, wrapped, pstack, xin,
+                                     feed_local, mc, rng)
+                # the loss lane: last chunk (v = V-1) on the last
+                # device, microbatch in range
+                valid = ((u >= (V - 1) * M) & (u < V * M)
+                         & (r == S - 1))
+                loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
+                y = y.astype(carry_dt)
+                y = lax.ppermute(y, axis, ring)
+                wbuf = lax.dynamic_update_index_in_dim(wbuf, y, slot, 0)
+                return (y, wbuf, loss_acc)
+
+            T = V * M + S - 1
+            niters = -(-T // K)
+            carry = (x0, wbuf0, loss0)
+
+            def body(cc, t0):
+                for k in range(K):
+                    cc = tick(cc, t0 + k)
+                return cc, None
+
+            carry, _ = lax.scan(body, carry, K * jnp.arange(niters))
+            return carry[2] / M
+
+        loss_part, grads_local = jax.value_and_grad(
+            schedule_loss)(params_local)
+        return loss_part[None], grads_local
+
     @staticmethod
     def _norm_feeds(feeds_all):
         return tuple(tuple(fs) for fs in feeds_all)
@@ -310,8 +459,9 @@ class CollectiveGPipe:
             f_specs = P(self.axis_name)
         else:
             f_specs = jax.tree_util.tree_map(lambda _: P(), feeds_all)
+        body = self._body if self.V == 1 else self._body_interleaved
         loss_and_grads = _shard_map_unchecked(
-            self._body, mesh=self.mesh,
+            body, mesh=self.mesh,
             in_specs=(p_specs, f_specs, P(), P()),
             out_specs=(P(self.axis_name), p_specs))
         opt = self.optimizer
@@ -374,13 +524,14 @@ class CollectiveGPipe:
         # structure (fill/steady/drain counts) as attributes instead
         if self.feed_mode == "sharded":
             with tel.span("cpp_pack_feeds",
-                          bytes=self.S * self._row_bytes):
+                          bytes=self.S_dev * self._row_bytes):
                 feeds = self._pack_feeds(feeds_all)
         else:
             with tel.span("cpp_replicate_feeds"):
                 feeds = self._replicate(feeds_all)
         S, M = self.S, self.M
-        fill = S - 1 if self.unroll_fill_drain else 0
+        fill = (S - 1 if self.unroll_fill_drain and self.V == 1
+                else 0)
         # black box: the schedule is one SPMD program dispatched by
         # every rank in lockstep — a "collective"-group flight entry per
         # dispatch gives the blackbox CLI an aligned seq stream, so the
@@ -388,10 +539,11 @@ class CollectiveGPipe:
         # rest) is nameable by its first seq divergence
         frec = tel.flight_start("collective", "cpp_dispatch",
                                 tag=f"step{int(step)}",
-                                nbytes=self.S * self._row_bytes)
-        with tel.span("cpp_dispatch", ticks=M + S - 1, fill=fill,
+                                nbytes=self.S_dev * self._row_bytes)
+        with tel.span("cpp_dispatch", ticks=self.n_ticks, fill=fill,
                       drain=fill, fuse_ticks=self.fuse_ticks,
-                      stages=S, microbatches=M):
+                      stages=S, microbatches=M,
+                      virtual_stages=self.V):
             out = self._step(tuple(stacked_params), tuple(opt_state),
                              feeds, base_rng, jnp.int32(step),
                              jnp.float32(lr))
@@ -399,15 +551,27 @@ class CollectiveGPipe:
         return out
 
     # -- placement helpers ----------------------------------------------
+    def stack_stage_values(self, per_stage):
+        """Host-stack one per-stage value list into the schedule's
+        layout: [S, ...] for V=1, [S_dev, V, ...] with chunk
+        ``v*S_dev + r`` at position ``[r, v]`` for the interleaved
+        schedule — dim 0 is the stage mesh axis either way."""
+        if self.V == 1:
+            return np.stack([np.asarray(x) for x in per_stage])
+        return np.stack([
+            np.stack([np.asarray(per_stage[v * self.S_dev + r])
+                      for v in range(self.V)])
+            for r in range(self.S_dev)])
+
     def place_stacked(self, arrs_by_stage):
-        """Stack per-stage host/device arrays into [S, ...] sharded over
-        the stage axis."""
+        """Stack per-stage host/device arrays into [S(,V), ...] sharded
+        over the stage axis."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh = NamedSharding(self.mesh, P(self.axis_name))
         out = []
         nper = len(arrs_by_stage[0])
         for j in range(nper):
-            stacked = np.stack([np.asarray(arrs_by_stage[s][j])
-                                for s in range(self.S)])
+            stacked = self.stack_stage_values(
+                [arrs_by_stage[s][j] for s in range(self.S)])
             out.append(jax.device_put(stacked, sh))
         return out

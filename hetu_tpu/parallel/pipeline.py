@@ -53,7 +53,8 @@ from ..ops.variable import PlaceholderOp
 from ..ops.comm import PipelineSendOp, PipelineReceiveOp
 from .. import telemetry as _telemetry
 
-__all__ = ["PipelineSubExecutor"]
+__all__ = ["PipelineSubExecutor", "analytic_bubble_fraction",
+           "virtual_stage_program"]
 
 _NULL_CM = _telemetry.NULL.span("")     # shared no-op context manager
 
@@ -177,6 +178,47 @@ def _drive_1f1b(forward, backward, nstages, M, telemetry=None):
             done_b += 1
 
 
+def analytic_bubble_fraction(nstages, M, V=1, schedule="1f1b"):
+    """Inherent idle fraction of a pipeline schedule: ``nstages`` is
+    the TOTAL user stage count; with ``V`` virtual stages per
+    device/rank the pipeline depth folds to ``nstages/V`` and the
+    schedule runs ``V*M`` chunk-ticks — the Megatron interleaving
+    result, bubble ~ 1/V smaller at small M. GPipe and 1F1B share the
+    same fill/drain analytics (1F1B reduces peak memory, not bubble).
+    The cost-model planner and the telemetry both use this ONE
+    definition."""
+    del schedule
+    V = max(1, int(V))
+    S = max(1, int(nstages))
+    if V > 1 and S % V == 0:
+        sd = S // V
+        return (sd - 1) / (V * M + sd - 1)
+    return (S - 1) / (M + S - 1)
+
+
+def virtual_stage_program(nranks, nstages, M):
+    """Per-rank symbolic (phase, microbatch, stage) event program of
+    the interleaved staged schedule: stages placed round-robin (stage s
+    on rank s % nranks, i.e. V = nstages/nranks chunks per rank),
+    driven by the SAME ``_drive_1f1b`` order the runtime executes —
+    forward(m) visits a rank's chunks in ascending stage order,
+    backward(m) in descending. This is the event-program form
+    ``analysis/deadlock.py`` verifies (HT3xx) before a fleet launches
+    with ``virtual_stages > 1``."""
+    progs = {r: [] for r in range(nranks)}
+
+    def forward(m):
+        for s in range(nstages):
+            progs[s % nranks].append(("fwd", m, s))
+
+    def backward(m):
+        for s in reversed(range(nstages)):
+            progs[s % nranks].append(("bwd", m, s))
+
+    _drive_1f1b(forward, backward, nstages, M)
+    return progs
+
+
 def _owner_of(hostname, nprocs):
     """Worker-process rank that owns a stage hostname (reference device
     specs 'hostname:gpu:i', context.py:59-63). Conventions:
@@ -264,8 +306,29 @@ class PipelineSubExecutor:
         topo = find_topo_sort(self.eval_nodes)
         topo = self._splice_send_recv(topo)
         self._build_stages(topo)
+        # interleaved (virtual-stage) schedule: V > 1 means the user's
+        # S stages fold onto S/V devices (collective mode) or S/V
+        # worker ranks (staged 1F1B with round-robin contexts); the
+        # analytic bubble shrinks to (S/V - 1)/(V*M + S/V - 1)
+        self.virtual_stages = max(1, int(
+            (getattr(config, "pp_options", None) or {})
+            .get("virtual_stages", 1) or 1))
         self.num_microbatches = num_microbatches or max(
             2, len(self.stages))
+        if self.virtual_stages > 1 and self.multiproc:
+            # staged interleaved 1F1B = round-robin stage->rank
+            # placement under the unchanged 1F1B driver (the channel's
+            # blocking recvs realize the interleaving); a blocked
+            # placement would silently forfeit the bubble reduction
+            owners = [s.owner for s in self.stages]
+            nr = len(set(owners))
+            if len(owners) % nr != 0 or any(
+                    o != owners[i % nr] for i, o in enumerate(owners)):
+                raise ValueError(
+                    f"virtual_stages={self.virtual_stages} needs "
+                    f"round-robin stage ownership (stage i on rank "
+                    f"i % {nr}); got owners {owners} — cycle the "
+                    f"worker contexts V times")
         self.step_count = 0
         self.batch_num = None
         self._losses_ema = None
@@ -835,11 +898,19 @@ class PipelineSubExecutor:
         self.step_count += 1
         tel = self.config.telemetry
         if tel.enabled:
-            # analytic GPipe bubble at this (S, M): the inherent
-            # (S-1)/(M+S-1) idle fraction; measured per-stage idle comes
-            # from the pp_stage_idle spans on cross-process runs
+            # analytic bubble at this (S, M, V): the inherent
+            # (S-1)/(M+S-1) idle fraction, shrinking to
+            # (S/V - 1)/(V*M + S/V - 1) under the interleaved
+            # schedule; measured per-stage idle comes from the
+            # pp_stage_idle spans on cross-process runs
             S, M = len(self.stages), self.num_microbatches
-            tel.observe("pp_bubble_fraction", (S - 1) / (M + S - 1))
+            V = self.virtual_stages
+            if V > 1 and S % V == 0:
+                sd = S // V
+                tel.observe("pp_bubble_fraction",
+                            (sd - 1) / (V * M + sd - 1))
+            else:
+                tel.observe("pp_bubble_fraction", (S - 1) / (M + S - 1))
         results = []
         for ev in self.eval_nodes:
             results.append(loss if ev is self.loss_node else None)
@@ -958,11 +1029,32 @@ class PipelineSubExecutor:
                 "pipeline_mode='collective' needs >= 2 stages (wrap "
                 "layer blocks in distinct ht.context(...) scopes)")
         devs = [s.device for s in stages]
-        if len(set(devs)) != S:
-            raise ValueError(
-                "pipeline_mode='collective' needs one distinct device "
-                f"per stage; got {devs} — on a single chip use the "
-                "staged/fused runners instead")
+        V = self.virtual_stages
+        if V > 1:
+            # interleaved schedule: S = S_dev * V user stages placed
+            # round-robin (stage i on device i % S_dev), each device
+            # owning V chunks — the Megatron virtual-stage layout
+            if S % V != 0:
+                raise ValueError(
+                    f"virtual_stages={V} must divide the stage count "
+                    f"{S}: build V chunks per device (contexts "
+                    f"cycling over the same device list V times)")
+            s_dev = S // V
+            if len(set(devs[:s_dev])) != s_dev or any(
+                    devs[i] != devs[i % s_dev] for i in range(S)):
+                raise ValueError(
+                    f"interleaved collective pipeline needs round-robin "
+                    f"placement: stage i on device i % {s_dev} "
+                    f"(got {devs}) — cycle the ht.context(...) device "
+                    f"list V={V} times over the same devices")
+        else:
+            s_dev = S
+            if len(set(devs)) != S:
+                raise ValueError(
+                    "pipeline_mode='collective' needs one distinct "
+                    f"device per stage; got {devs} — on a single chip "
+                    "use the staged/fused runners instead (or fold "
+                    "stages with pp_options virtual_stages)")
         if any(s.mesh is not None for s in stages):
             raise ValueError(
                 "pipeline_mode='collective' does not compose with "
@@ -1047,10 +1139,11 @@ class PipelineSubExecutor:
 
             return branch
 
-        mesh = Mesh(np.asarray(devs), axis_names=("stage",))
-        # tick-loop/feed-transport/boundary-dtype knobs (see
-        # CollectiveGPipe docstring); Executor(pp_options={...})
+        mesh = Mesh(np.asarray(devs[:s_dev]), axis_names=("stage",))
+        # tick-loop/feed-transport/boundary-dtype/virtual-stage knobs
+        # (see CollectiveGPipe docstring); Executor(pp_options={...})
         opts = dict(getattr(self.config, "pp_options", None) or {})
+        opts.setdefault("virtual_stages", V)
         cpp = CollectiveGPipe([make_branch(s) for s in range(S)],
                               b_aval, self.num_microbatches, mesh,
                               "stage", self.optimizer,
@@ -1059,7 +1152,8 @@ class PipelineSubExecutor:
         self._cpp_params = cpp.place_stacked(
             [[executor.params[str(p.id)] for p in st.param_nodes]
              for st in stages])
-        # stacked optimizer slots per position (same elementwise update)
+        # stacked optimizer slots per position (same elementwise
+        # update; the interleaved layout folds stages to [S_dev, V])
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh = NamedSharding(mesh, P("stage"))
         slots = []
@@ -1067,9 +1161,9 @@ class PipelineSubExecutor:
         for j, p0 in enumerate(stages[0].param_nodes):
             keys = sorted(full.get(p0.id, {}))
             slots.append({
-                k: jax.device_put(np.stack(
-                    [np.asarray(full[st.param_nodes[j].id][k])
-                     for st in stages]), sh)
+                k: jax.device_put(cpp.stack_stage_values(
+                    [full[st.param_nodes[j].id][k] for st in stages]),
+                    sh)
                 for k in keys})
         self._cpp_slots = slots
 
@@ -1078,11 +1172,19 @@ class PipelineSubExecutor:
             self._build_collective(executor, stacked_feeds)
             # ONE jitted unstack for the whole write-back (S*P*slots
             # individual slice dispatches per step would re-introduce
-            # the host-dispatch overhead this mode exists to remove)
+            # the host-dispatch overhead this mode exists to remove).
+            # Interleaved layout: stage s lives at [s % S_dev, s // S_dev]
+            sd, v = self._cpp.S_dev, self._cpp.V
+
+            def _at(arr, s):
+                return arr[s] if v == 1 else arr[s % sd][s // sd]
+
             self._cpp_unstack = jax.jit(
                 lambda ps, ss: (
-                    [[p[s] for p in ps] for s in range(len(self.stages))],
-                    [[{k: v[s] for k, v in slot.items()} for slot in ss]
+                    [[_at(p, s) for p in ps]
+                     for s in range(len(self.stages))],
+                    [[{k: _at(x, s) for k, x in slot.items()}
+                      for slot in ss]
                      for s in range(len(self.stages))]))
         loss, new_p, new_s = self._cpp.step(
             self._cpp_params, self._cpp_slots, stacked_feeds,

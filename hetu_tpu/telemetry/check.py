@@ -95,7 +95,8 @@ SPAN_SCHEMA = {
     "cpp_replicate_feeds": {},
     "cpp_dispatch": {"ticks": _req(_INT), "fill": _opt(_INT),
                      "drain": _opt(_INT), "fuse_ticks": _opt(_INT),
-                     "stages": _opt(_INT), "microbatches": _opt(_INT)},
+                     "stages": _opt(_INT), "microbatches": _opt(_INT),
+                     "virtual_stages": _opt(_INT)},
     # training health monitor (telemetry/health.py): one "health" span
     # per sampled check, one "health_trip" instant per ladder firing
     "health": {"step": _req(_INT), "layers": _opt(_INT),

@@ -45,7 +45,8 @@ import threading
 import time
 
 __all__ = ["CostDB", "default_db_path", "record_profile", "record_spans",
-           "comm_microbench", "ps_microbench", "COMM_KINDS", "main"]
+           "comm_microbench", "ps_microbench", "COMM_KINDS",
+           "cold_start_ms", "cold_start_flops_ms", "main"]
 
 _DB_ENV = "HETU_COSTDB"
 _VERSION = 1
@@ -82,6 +83,47 @@ def _shape_str(shape):
     except TypeError:
         return str(shape)
     return "x".join(dims) if dims else "scalar"
+
+
+# ---------------------------------------------------------------------------
+# cold-start heuristics: the analytic floor the planner trusts when the
+# DB has never measured a kind. Deliberately conservative, round-number
+# assumptions (documented in docs/parallelism.md "Cost-model inputs"):
+# a cold estimate must RANK plans sensibly, not predict wall clocks —
+# one comm_microbench sweep replaces all of these with measurements.
+# ---------------------------------------------------------------------------
+
+# assumed sustained bandwidth per comm kind, GB/s: PCIe-class for
+# host<->device, ICI-class for in-slice collectives, NIC-class for the
+# PS RPC path (each ~an order below marketing peak — sustained, not burst)
+_COLD_GBPS = {"h2d": 8.0, "d2h": 8.0, "allreduce": 40.0, "p2p": 40.0,
+              "ps_sparse_pull": 1.0, "ps_sparse_push": 1.0,
+              "ps_pull": 1.0, "ps_push": 1.0}
+_COLD_LATENCY_MS = {"h2d": 0.1, "d2h": 0.1, "allreduce": 0.05,
+                    "p2p": 0.02, "ps_sparse_pull": 0.3,
+                    "ps_sparse_push": 0.3, "ps_pull": 0.3,
+                    "ps_push": 0.3}
+# assumed achievable compute rate for the FLOPs-proportional compute
+# fallback when NO op of a graph was ever profiled (GFLOP/s: a CPU-core
+# class floor — any real accelerator measurement replaces it)
+_COLD_GFLOPS = 50.0
+
+
+def cold_start_ms(kind, nbytes):
+    """Analytic latency+bandwidth floor for a comm kind the DB has no
+    measurements for: ``latency + nbytes / bandwidth`` with the
+    documented ``_COLD_*`` assumptions (unknown kinds get the slowest
+    class). The planner's last resort — `coverage()` tells callers
+    which estimates rest on it."""
+    lat = _COLD_LATENCY_MS.get(kind, 0.3)
+    gbps = _COLD_GBPS.get(kind, 1.0)
+    return lat + max(0, int(nbytes)) / (gbps * 1e6)
+
+
+def cold_start_flops_ms(flops):
+    """FLOPs-proportional compute floor (``flops / _COLD_GFLOPS``) for
+    ops with no profiled entry and no calibration anchor in the DB."""
+    return max(0.0, float(flops)) / (_COLD_GFLOPS * 1e6)
 
 
 def pow2_bucket(nbytes):
@@ -243,12 +285,23 @@ class CostDB:
             return len(self._load())
 
     def coverage(self, required=COMM_KINDS):
-        """(present, missing) comm kinds — the doctor's cost-DB
-        coverage-gap report."""
+        """(measured, guessed) over ``required`` — the doctor's cost-DB
+        coverage-gap report and the autoplan report's measured-vs-
+        guessed split. Entries may be bare kinds (covered when ANY
+        entry of that kind exists) or ``(kind, shape[, dtype])`` tuples
+        (covered only by an exact entry — what the planner's per-op
+        lookups actually hit). A kind in the second list is served by
+        the cold-start heuristic, not a measurement."""
         have = set(self.kinds())
-        req = list(required)
-        return ([k for k in req if k in have],
-                [k for k in req if k not in have])
+        measured, guessed = [], []
+        for k in required:
+            if isinstance(k, (tuple, list)):
+                hit = self.get(*k) is not None
+            else:
+                hit = k in have
+            (measured if hit else guessed).append(
+                tuple(k) if isinstance(k, list) else k)
+        return measured, guessed
 
     # -- comm curves -----------------------------------------------------
     def curve(self, kind):
@@ -273,22 +326,39 @@ class CostDB:
         return {"latency_ms": round(lat, 5), "GBps": gbps,
                 "points": len(pts)}
 
-    def estimate_ms(self, kind, nbytes):
+    def estimate_ms(self, kind, nbytes, cold_start=False):
         """Predicted milliseconds for moving ``nbytes`` through ``kind``
         from the fitted curve (exact entry preferred when one exists) —
         the query the cost-model planner makes. Size-class entries come
         from two producers with different dtype tags (span points are
-        ``bytes``, microbench points ``float32``); try both."""
+        ``bytes``, microbench points ``float32``); try both.
+
+        ``cold_start=True`` never returns None: a kind with no entries
+        falls back to the documented link-speed heuristic
+        (:func:`cold_start_ms`) so a fresh checkout can still rank
+        plans — the planner reports which estimates came from
+        measurement via :meth:`coverage` / :meth:`estimate_info`."""
+        ms, _src = self.estimate_info(kind, nbytes,
+                                      cold_start=cold_start)
+        return ms
+
+    def estimate_info(self, kind, nbytes, cold_start=True):
+        """(ms, source) where source is ``"measured"`` (exact size-class
+        entry), ``"curve"`` (latency+bandwidth fit), or
+        ``"cold_start"`` (analytic heuristic; None when cold_start is
+        off and the DB is empty for the kind)."""
         bucket = pow2_bucket(nbytes)
         ent = self.get(kind, bucket, "bytes") or self.get(kind, bucket)
         if ent is not None:
-            return float(ent["ms"])
+            return float(ent["ms"]), "measured"
         cv = self.curve(kind)
-        if cv is None:
-            return None
-        gbps = cv["GBps"]
-        bw_ms = 0.0 if not gbps else nbytes / (gbps * 1e6)
-        return cv["latency_ms"] + bw_ms
+        if cv is not None:
+            gbps = cv["GBps"]
+            bw_ms = 0.0 if not gbps else nbytes / (gbps * 1e6)
+            return cv["latency_ms"] + bw_ms, "curve"
+        if not cold_start:
+            return None, None
+        return cold_start_ms(kind, nbytes), "cold_start"
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,11 @@ _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 # record, not as their own metric). Value: True = lower is better.
 # overlap_fraction is the ingest engine's host-hidden share (ingest.py)
 # — HIGHER is better; ingest_wait_ms is device-waited-on-host — lower.
-_FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True}
+# bubble_fraction is the pipeline's analytic idle share (pipeline.py)
+# — lower; autoplan_vs_hand is the planner's throughput ratio against
+# the best hand config (parallel/autoplan.py) — higher.
+_FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
+                    "bubble_fraction": True, "autoplan_vs_hand": False}
 
 # informational per-record fields (the health monitor's stamps,
 # telemetry/health.py): reported so a reviewer sees a NaN run on its
