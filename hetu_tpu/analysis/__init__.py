@@ -24,10 +24,17 @@ provenance:
    ``run()`` loops that never engage it (runtime half in
    ``executor.py``).
 
+Two codebase self-lints ride beside the graph passes: **jit_purity**
+(HTPxx — host impurity inside jit-traced bodies) and **concurrency**
+(HT6xx — lockset/lock-order/lifecycle verification of the threaded
+host runtime, with ``racecheck.py`` as its dynamic instrumented-lock
+twin).
+
 Surfaces: ``Executor(validate="error"|"warn"|"off")``,
 ``heturun --preflight``, ``python -m hetu_tpu.analysis`` (zoo CLI),
-``python -m hetu_tpu.analysis.jit_purity`` (codebase self-lint), and a
-graphboard finding overlay. See ``docs/analysis.md``.
+``python -m hetu_tpu.analysis.jit_purity`` and
+``python -m hetu_tpu.analysis.concurrency`` (codebase self-lints), and
+a graphboard finding overlay. See ``docs/analysis.md``.
 """
 from __future__ import annotations
 

@@ -32,7 +32,7 @@ from __future__ import annotations
 import html
 import os
 
-__all__ = ["show", "render", "close", "to_dot"]
+__all__ = ["show", "render", "close", "to_dot", "ServerHandle"]
 
 _server = None
 
@@ -333,14 +333,38 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
     return path
 
 
+class ServerHandle(str):
+    """The URL ``show(port=...)`` returns, carrying the server it
+    points at: ``shutdown()`` stops ``serve_forever``, **joins** the
+    serving thread, and releases the listening socket (the daemon
+    thread used to have no shutdown path at all — HT604). Being a
+    ``str`` subclass keeps every existing ``urlopen(show(...))``
+    call site working unchanged."""
+
+    def __new__(cls, url, httpd, thread):
+        obj = super().__new__(cls, url)
+        obj._httpd = httpd
+        obj._thread = thread
+        return obj
+
+    def shutdown(self):
+        if self._httpd is None:
+            return
+        from .telemetry.metrics import stop_http_server
+        stop_http_server(self._httpd, self._thread)
+        self._httpd = None
+
+
 def show(executor, path="graphboard.html", port=None, costs=None,
          findings=None):
     """Render and (optionally) serve like the reference's graphboard
-    (graph2fig.py:11-33). ``port=None`` skips the server; ``costs``
-    (``profile_ops`` output) overlays per-op cost heat coloring;
-    ``findings`` (an ``analysis.Report``, e.g.
-    ``executor.config.analysis_report``) overlays preflight
-    diagnostics."""
+    (graph2fig.py:11-33). ``port=None`` skips the server; with a port
+    the returned URL is a :class:`ServerHandle` whose ``shutdown()``
+    tears the server down cleanly (module-level :func:`close` does the
+    same for the last-started one). ``costs`` (``profile_ops`` output)
+    overlays per-op cost heat coloring; ``findings`` (an
+    ``analysis.Report``, e.g. ``executor.config.analysis_report``)
+    overlays preflight diagnostics."""
     out = render(executor, path, costs=costs, findings=findings)
     if port is None:
         return out
@@ -351,13 +375,18 @@ def show(executor, path="graphboard.html", port=None, costs=None,
     handler = functools.partial(
         http.server.SimpleHTTPRequestHandler,
         directory=os.path.dirname(os.path.abspath(out)) or ".")
-    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                              handler)
-    threading.Thread(target=_server.serve_forever, daemon=True).start()
-    return f"http://127.0.0.1:{port}/{os.path.basename(out)}"
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="graphboard-http")
+    thread.start()
+    _server = ServerHandle(
+        f"http://127.0.0.1:{port}/{os.path.basename(out)}", httpd, thread)
+    return _server
 
 
 def close():
+    """Shut down the server the last :func:`show` started (joins its
+    thread and releases the socket)."""
     global _server
     if _server is not None:
         _server.shutdown()

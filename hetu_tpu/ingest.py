@@ -33,10 +33,11 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import CancelledError, ThreadPoolExecutor
+from concurrent.futures import CancelledError, Future
 
-__all__ = ["OverlapOptions", "IngestEngine", "IngestError", "on_worker",
-           "overlap_fraction", "new_stats", "merge_stats", "stats_fields"]
+__all__ = ["OverlapOptions", "IngestEngine", "IngestError", "DaemonPool",
+           "on_worker", "overlap_fraction", "new_stats", "merge_stats",
+           "stats_fields"]
 
 _worker_local = threading.local()
 
@@ -159,6 +160,91 @@ def stats_fields(stats):
     }
 
 
+class DaemonPool:
+    """Tiny ``submit()``/``shutdown()`` pool over **daemon** threads.
+
+    Why not ``ThreadPoolExecutor``: its workers are non-daemon and
+    ``concurrent.futures`` joins every one of them at interpreter exit.
+    A worker wedged in a blocking job — a PS push retrying against a
+    dead server, an ingest job stuck in ``queue.get`` — therefore hangs
+    the *interpreter*, not just the owner (the HT603/HT604 class the
+    concurrency verifier flags). Here workers are daemon threads with a
+    cooperative stop flag, ``shutdown(wait=True)`` bounds its join with
+    a timeout, and a wedged worker is abandoned to die with the process
+    instead of deadlocking teardown.
+
+    Jobs return ``concurrent.futures.Future`` with the standard
+    cancel/result/exception semantics; one worker (the default) keeps
+    submission order — the IngestEngine ordering contract.
+    """
+
+    def __init__(self, max_workers=1, thread_name_prefix="hetu-pool"):
+        self._jobs = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"{thread_name_prefix}-{i}")
+            for i in range(max(1, int(max_workers)))]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, *args, **kwargs):
+        fut = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("submit after DaemonPool.shutdown()")
+            self._jobs.append((fut, fn, args, kwargs))
+            self._cv.notify()
+        return fut
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._jobs:
+                    if self._closed:
+                        return
+                    self._cv.wait()
+                fut, fn, args, kwargs = self._jobs.popleft()
+            if not fut.set_running_or_notify_cancel():
+                continue                # cancelled while queued
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+    def shutdown(self, wait=True, cancel_futures=False, timeout=30.0):
+        """Stop the workers. ``cancel_futures`` drops queued-but-
+        unstarted jobs (their futures raise CancelledError); ``wait``
+        joins the workers but — unlike ThreadPoolExecutor — bounded by
+        ``timeout`` per pool, so a job wedged in a blocking call can
+        never deadlock teardown or interpreter exit. Returns True when
+        every worker actually exited."""
+        with self._cv:
+            self._closed = True
+            if cancel_futures:
+                while self._jobs:
+                    fut, _fn, _a, _kw = self._jobs.popleft()
+                    fut.cancel()
+            self._cv.notify_all()
+        ok = True
+        if wait:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            for t in self._threads:
+                t.join(None if deadline is None
+                       else max(0.0, deadline - time.monotonic()))
+                ok = ok and not t.is_alive()
+        return ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(cancel_futures=exc_type is not None)
+        return False
+
+
 class IngestEngine:
     """Ordered background ingest worker with a bounded pending queue.
 
@@ -179,7 +265,7 @@ class IngestEngine:
         self.lookahead = int(lookahead)
         self.name = name
         self.sink = sink
-        self._pool = ThreadPoolExecutor(
+        self._pool = DaemonPool(
             max_workers=1, thread_name_prefix=f"hetu-{name}")
         self._pending = deque()
         self.wait_ms = []
@@ -250,11 +336,23 @@ class IngestEngine:
         """Shut the worker down. ``cancel=True`` (the error path) drops
         queued-but-unstarted jobs instead of waiting them out — the
         round-6 stream leaked here by waiting for every pending ingest
-        before re-raising."""
+        before re-raising. Teardown can never deadlock on a worker
+        wedged in a blocking job (``queue.get``, a PS RPC against a
+        dead server): the worker is a daemon thread and the clean-path
+        join is bounded, so both mid-error teardown and interpreter
+        exit proceed while the wedged job dies with the process."""
         if self._closed:
             return
         self._closed = True
-        self._pool.shutdown(wait=not cancel, cancel_futures=cancel)
+        ok = self._pool.shutdown(wait=not cancel, cancel_futures=cancel)
+        if not cancel and not ok:
+            # the bounded join expired on the CLEAN path: a job is
+            # still running past the old wait-it-out guarantee — say
+            # so instead of silently abandoning it mid-side-effect
+            import sys
+            print(f"[hetu-ingest] close(): worker '{self.name}' still "
+                  f"busy after the shutdown timeout; abandoning the "
+                  f"daemon worker", file=sys.stderr)
         merge_stats(self.sink, wait_ms=self.wait_ms, busy_ms=self.busy_ms,
                     pops=len(self.wait_ms))
 

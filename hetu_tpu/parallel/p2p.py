@@ -58,8 +58,8 @@ class PipeChannel:
         self._sending = 0         # sends in flight (see backpressure)
         self.max_buffered = int(os.environ.get(
             "HETU_PIPE_MAX_BUF_MB", "256")) << 20
-        self._out = {}            # dst rank -> socket
-        self._out_mu = threading.Lock()
+        self._out = {}            # dst rank -> (socket, send lock)
+        self._out_mu = threading.Lock()   # guards the MAP only
         self._closing = False
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
@@ -196,25 +196,48 @@ class PipeChannel:
 
     # -- send side -------------------------------------------------------
     def _conn_to(self, dst):
+        """(socket, per-destination send lock) for ``dst``."""
         with self._out_mu:
-            s = self._out.get(dst)
-            if s is not None:
-                return s
-            host, port = self.addrs[dst]
-            deadline = 60.0
-            import time
-            t0 = time.time()
-            while True:
-                try:
-                    s = socket.create_connection((host, port), timeout=5)
-                    break
-                except OSError:
-                    if time.time() - t0 > deadline:
-                        raise
-                    time.sleep(0.1)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._out[dst] = s
-            return s
+            ent = self._out.get(dst)
+        if ent is not None:
+            return ent
+        # connect OUTSIDE the map lock (HT603 finding): the 60s retry
+        # loop against a not-yet-listening peer must not stall sends to
+        # every OTHER rank behind _out_mu
+        host, port = self.addrs[dst]
+        deadline = 60.0
+        import time
+        t0 = time.time()
+        while True:
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.time() - t0 > deadline:
+                    raise
+                time.sleep(0.1)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        drop = None
+        with self._out_mu:
+            ent = self._out.get(dst)
+            if ent is not None:
+                # two senders raced the first connect: keep the socket
+                # already in the map (its peer may have received bytes)
+                drop = s
+            elif self._closing:
+                # close() already cleared the map: storing now would
+                # leak a socket nothing will ever close
+                drop = s
+            else:
+                ent = self._out[dst] = (s, threading.Lock())
+        if drop is not None:
+            try:
+                drop.close()
+            except OSError:
+                pass
+        if ent is None:
+            raise OSError("PipeChannel is closed")
+        return ent
 
     def send(self, dst, tag, arr):
         tel = _telemetry.get_telemetry()
@@ -236,12 +259,16 @@ class PipeChannel:
                + struct.pack("<i", arr.ndim)
                + struct.pack(f"<{arr.ndim}q", *arr.shape))
         view = memoryview(arr).cast("B")
-        s = self._conn_to(dst)
+        s, send_lk = self._conn_to(dst)
         with self._cv:
             self._sending += 1
             self._cv.notify_all()   # readers may admit while we send
         try:
-            with self._out_mu:
+            # per-DESTINATION send lock: frames on one socket must not
+            # interleave, but a huge boundary tensor to one rank (or
+            # its TCP-backpressure stall) must not block sends to every
+            # other rank behind a channel-wide lock
+            with send_lk:
                 s.sendall(hdr)
                 # stream the payload from the array's own buffer in
                 # chunks: no whole-message copy, and large boundary
@@ -262,7 +289,7 @@ class PipeChannel:
         except OSError:
             pass
         with self._out_mu:
-            for s in self._out.values():
+            for s, _lk in self._out.values():
                 try:
                     s.close()
                 except OSError:
@@ -271,13 +298,18 @@ class PipeChannel:
 
 
 _channel = None
+_channel_mu = threading.Lock()
 
 
 def get_channel():
-    """Process-wide channel, built from the launcher env on first use."""
+    """Process-wide channel, built from the launcher env on first use.
+    Double-checked: two pipeline runner threads first-touching the
+    channel must not both bind the listener (HT605)."""
     global _channel
     if _channel is None:
-        rank = int(os.environ.get("HETU_PROC_ID", "0"))
-        nprocs = int(os.environ.get("HETU_NUM_PROCS", "1"))
-        _channel = PipeChannel(rank, nprocs)
+        with _channel_mu:
+            if _channel is None:
+                rank = int(os.environ.get("HETU_PROC_ID", "0"))
+                nprocs = int(os.environ.get("HETU_NUM_PROCS", "1"))
+                _channel = PipeChannel(rank, nprocs)
     return _channel

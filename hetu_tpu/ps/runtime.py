@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 
 import numpy as np
@@ -85,8 +86,12 @@ class PSRuntime:
         self._pending_push = []
         self.updates_dropped = False   # drain() skipped post-shutdown
         if config.prefetch and not config.bsp:
-            from concurrent.futures import ThreadPoolExecutor
-            self._push_pool = ThreadPoolExecutor(max_workers=2)
+            # daemon workers with a bounded-join shutdown (ingest.py):
+            # a push wedged in an RPC against a dead server must never
+            # deadlock close()/interpreter exit (HT603/HT604)
+            from ..ingest import DaemonPool
+            self._push_pool = DaemonPool(max_workers=2,
+                                         thread_name_prefix="hetu-ps-push")
         # dense HET pipeline (unified with the embedding cache): dense PS
         # params are locally optimizer-updated in-graph with grads
         # accumulated in HBM state (optimizer.backward_hook); the drain
@@ -94,6 +99,12 @@ class PSRuntime:
         self._dense_steps = 0
         self._dense_future = None
         self._dense_ready = None     # {sid: np value} to swap in
+        # _dense_ready is handed from the push-pool cycle to the step
+        # loop; _times_mu guards the phase counters the ingest worker's
+        # prep phases and the step loop both accumulate (both were
+        # HT601 lockset findings)
+        self._dense_mu = threading.Lock()
+        self._times_mu = threading.Lock()
         # step-phase timing (VERDICT: make the residual gap attributable)
         self.times = {"slot_assign": 0.0, "miss_fill": 0.0, "refresh": 0.0,
                       "dispatch": 0.0, "drain_submit": 0.0, "dense": 0.0,
@@ -135,7 +146,8 @@ class PSRuntime:
         try:
             yield
         finally:
-            self.times[name] += time.perf_counter() - t0
+            with self._times_mu:    # prep phases run on the ingest worker
+                self.times[name] += time.perf_counter() - t0
             tel.flight_complete(frec)
             if tel.enabled:
                 t1n = tel.clock()
@@ -291,7 +303,8 @@ class PSRuntime:
 
         # swap in dense parameters rebased by a completed drain cycle
         # (multi-worker: the server value folds the other workers' pushes)
-        ready, self._dense_ready = self._dense_ready, None
+        with self._dense_mu:
+            ready, self._dense_ready = self._dense_ready, None
         if ready:
             for sid, (param, value) in ready.items():
                 if sid in executor.params:
@@ -791,7 +804,8 @@ class PSRuntime:
         nsteps = len(feed_dicts)
         cached = self._cached_for(sub)
 
-        ready, self._dense_ready = self._dense_ready, None
+        with self._dense_mu:
+            ready, self._dense_ready = self._dense_ready, None
         if ready:
             for sid, (param, value) in ready.items():
                 if sid in executor.params:
@@ -990,7 +1004,8 @@ class PSRuntime:
                     ready[sid] = (param, self.client.pull(
                         param.id, (int(np.prod(param.shape)),)))
             if ready:
-                self._dense_ready = ready
+                with self._dense_mu:
+                    self._dense_ready = ready
 
         if self._push_pool is not None and not wait:
             self._dense_future = self._push_pool.submit(cycle)
@@ -1060,6 +1075,20 @@ class PSRuntime:
         import atexit
         atexit.unregister(self._atexit)   # don't pin HBM buffers for life
         self.drain()
+        if self._push_pool is not None:
+            # after drain() the workers are idle, so the bounded join
+            # is immediate on the clean path; post-shutdown_servers()
+            # (updates_dropped) a push may be wedged in an RPC retry —
+            # cancel the queue and abandon the daemon worker rather
+            # than deadlocking teardown on it
+            ok = self._push_pool.shutdown(
+                wait=not self.updates_dropped,
+                cancel_futures=self.updates_dropped, timeout=30.0)
+            if not self.updates_dropped and not ok:
+                import sys
+                print("[hetu-ps] close(): push worker still busy after "
+                      "the shutdown timeout; abandoning the daemon "
+                      "worker", file=sys.stderr)
         if self.config.telemetry.enabled:
             self.phase_breakdown()    # final cache-counter gauges
 
@@ -1073,14 +1102,16 @@ class PSRuntime:
     def reset_phase_times(self):
         """Zero the phase counters (bench: exclude warmup from the
         steady-state breakdown)."""
-        for k in self.times:
-            self.times[k] = 0.0
+        with self._times_mu:
+            for k in self.times:
+                self.times[k] = 0.0
 
     def phase_breakdown(self):
         """Accumulated per-phase host seconds (bench attribution); also
         publishes the device-cache hit/miss/evict counters as telemetry
         gauges so a Prometheus scrape sees them."""
-        out = dict(self.times)
+        with self._times_mu:
+            out = dict(self.times)
         tel = self.config.telemetry
         for rt in self.device_tables.values():
             perf = rt.perf

@@ -75,8 +75,13 @@ class MicroBatcher:
                 f"{self.max_batch_size}; split it client-side")
         req = _Request(arrays, n, Future())
         with self._cond:
+            # submit/close race contract (pinned by the racecheck
+            # stress test): a submit that wins the lock before close()
+            # flips _closed is queued and WILL be served by the drain
+            # loop; one that loses raises here — never hangs, never
+            # silently drops
             if self._closed:
-                raise RuntimeError("MicroBatcher is closed")
+                raise RuntimeError("batcher closed")
             self._queue.append(req)
             self._set_depth()
             self._cond.notify()
@@ -102,35 +107,62 @@ class MicroBatcher:
             deadline = self._queue[0].t_submit + self.max_wait
             batch, total = [], 0
             keys = frozenset(self._queue[0].feeds)
-            while True:
-                while self._queue and \
-                        frozenset(self._queue[0].feeds) == keys and \
-                        (not batch
-                         or total + self._queue[0].n
-                         <= self.max_batch_size):
-                    req = self._queue.popleft()
-                    batch.append(req)
-                    total += req.n
-                if total >= self.max_batch_size or self._closed:
-                    break
-                if self._queue:
-                    # head doesn't fit, or carries a DIFFERENT feed-key
-                    # set (coalescing it would drop its extra keys):
-                    # it starts the next tick
-                    break
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
+            try:
+                while True:
+                    while self._queue and \
+                            frozenset(self._queue[0].feeds) == keys and \
+                            (not batch
+                             or total + self._queue[0].n
+                             <= self.max_batch_size):
+                        req = self._queue.popleft()
+                        batch.append(req)
+                        total += req.n
+                    if total >= self.max_batch_size or self._closed:
+                        break
+                    if self._queue:
+                        # head doesn't fit, or carries a DIFFERENT
+                        # feed-key set (coalescing it would drop its
+                        # extra keys): it starts the next tick
+                        break
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            except BaseException:
+                # crash mid-coalesce (e.g. an interrupt landing in the
+                # straggler wait): put the claimed requests back so
+                # _loop's crash handler fails THEIR futures too instead
+                # of stranding them in this frame's local
+                self._queue.extendleft(reversed(batch))
+                raise
             self._set_depth()
             return batch
 
     def _loop(self):
-        while True:
-            batch = self._take_tick()
-            if batch is None:
-                return
-            self._serve(batch)
+        batch = None
+        try:
+            while True:
+                batch = self._take_tick()
+                if batch is None:
+                    return
+                self._serve(batch)
+                batch = None
+        except BaseException as e:      # noqa: BLE001 — tick machinery died
+            # _serve guards serve_fn, but a crash in the tick machinery
+            # itself (or a KeyboardInterrupt landing on this thread)
+            # must not strand every queued/future submit in a silent
+            # hang: refuse new requests and fail the queued ones AND
+            # the in-flight batch already popped off the queue
+            with self._cond:
+                self._closed = True
+                pending = list(self._queue)
+                self._queue.clear()
+                self._cond.notify_all()
+            for r in (batch or []) + pending:
+                if not r.future.done():
+                    r.future.set_exception(
+                        RuntimeError(f"batcher thread died: {e!r}"))
+            raise
 
     def _serve(self, batch):
         # the WHOLE tick is guarded: a malformed request (ragged trailing
@@ -177,11 +209,21 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     def close(self):
         """Stop accepting requests, serve what's queued, join the
-        thread."""
+        thread. Idempotent; safe to race with submit() — see the
+        contract note there."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._thread.join()
+        # belt-and-braces: if the loop died (crash path above) between
+        # a submit and its tick, nothing serves the leftovers — fail
+        # them instead of letting .result() hang forever
+        with self._cond:
+            leftover = list(self._queue)
+            self._queue.clear()
+        for r in leftover:
+            if not r.future.done():
+                r.future.set_exception(RuntimeError("batcher closed"))
 
     def __enter__(self):
         return self

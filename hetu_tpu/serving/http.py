@@ -177,8 +177,9 @@ class ServingHTTPServer:
 
     def stop(self):
         if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+            from ..telemetry.metrics import stop_http_server
+            stop_http_server(self._httpd, self._thread)
+            self._thread = None
             self._httpd = None
 
     def __enter__(self):

@@ -600,34 +600,43 @@ class HealthMonitor:
     def _write(self, rec):
         if not self.out_dir:
             return
-        if self._fh is None:
+        # one lock over open AND write: an ingest-worker observation
+        # and the step loop's sample can race both the first open
+        # (HT605 check-then-create — only one may truncate the file)
+        # and the write itself (TextIOWrapper is not thread-safe; two
+        # interleaved json lines corrupt the record the doctor parses)
+        with self._lock:
+            if self._fh is None:
+                if not self.out_dir:
+                    return              # a failed open already gave up
+                try:
+                    os.makedirs(self.out_dir, exist_ok=True)
+                    path = os.path.join(
+                        self.out_dir, f"health_rank{self.rank}.jsonl")
+                    mode = "a" if path in _OPENED_PATHS else "w"
+                    _OPENED_PATHS.add(path)
+                    self._fh = open(path, mode)
+                except OSError:
+                    self.out_dir = None     # never retry per step
+                    return
             try:
-                os.makedirs(self.out_dir, exist_ok=True)
-                path = os.path.join(
-                    self.out_dir, f"health_rank{self.rank}.jsonl")
-                mode = "a" if path in _OPENED_PATHS else "w"
-                _OPENED_PATHS.add(path)
-                self._fh = open(path, mode)
-            except OSError:
-                self.out_dir = None     # never retry per step
-                return
-        try:
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
-        except (OSError, ValueError):
-            pass
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+            except (OSError, ValueError):
+                pass
 
     def close(self):
         if self._closed:
             return
         self._closed = True
         _MONITORS.discard(self)
-        if self._fh is not None:
-            try:
-                self._fh.close()
-            except OSError:
-                pass
-            self._fh = None
+        with self._lock:                # serialize vs an in-flight _write
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,21 @@ import time
 
 import numpy as np
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "stop_http_server"]
+
+
+def stop_http_server(httpd, thread):
+    """The one clean serve_forever teardown, shared by every HTTP
+    surface (metrics scrape, graphboard, serving frontend): stop the
+    serve loop, JOIN the serving thread (so thread-leak checks see it
+    actually gone), then ``server_close()`` to release the listening
+    socket — a second fleet reusing the port must not hit TIME_WAIT on
+    a socket the old server still holds open."""
+    httpd.shutdown()
+    if thread is not None:
+        thread.join()
+    httpd.server_close()
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -114,6 +128,7 @@ class MetricsRegistry:
         self._metrics = {}
         self._lock = threading.Lock()
         self._server = None
+        self._server_thread = None
 
     def _get(self, name, cls, **kw):
         with self._lock:
@@ -204,18 +219,18 @@ class MetricsRegistry:
 
         self._server = http.server.ThreadingHTTPServer((host, port),
                                                        Handler)
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="metrics-scrape")
+        self._server_thread.start()
         return self._server.server_address[1]
 
     def shutdown(self):
-        """Stop the scrape server AND release its listening socket —
-        the clean form (close() kept as an alias for existing callers).
-        A second fleet reusing the port must not hit TIME_WAIT on a
-        socket the old registry still holds open."""
+        """Stop the scrape server cleanly (:func:`stop_http_server`);
+        close() kept as an alias for existing callers."""
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
+            stop_http_server(self._server, self._server_thread)
+            self._server_thread = None
             self._server = None
 
     def close(self):

@@ -82,7 +82,7 @@ def platform_tag():
             dev = jax.devices()[0]
             kind = (getattr(dev, "device_kind", "")
                     or jax.default_backend())
-            _PLATFORM = "".join(
+            _PLATFORM = "".join(          # lock-ok: HT605 idempotent memo: racing writers compute identical values, swap is atomic
                 c if c.isalnum() else "_"
                 for c in str(kind).strip().lower()) or "unknown"
         except Exception:

@@ -32,6 +32,70 @@ def _seed():
     np.random.seed(0)
 
 
+# ---------------------------------------------------------------------------
+# thread hygiene (ISSUE 12): a test that leaks a live non-daemon thread
+# fails — leaked threads outlive the test, hang interpreter exit, and
+# poison later tests' thread-leak baselines one test too late.
+# ---------------------------------------------------------------------------
+
+# names (prefix match) of non-daemon threads that are allowed to
+# outlive a test; extend deliberately, with a reason
+THREAD_LEAK_ALLOWLIST = (
+    "pytest",           # pytest-timeout & friends
+    "pydevd",           # debugger attach
+)
+
+
+def _leaked_nondaemon(before):
+    import threading
+    out = []
+    for t in threading.enumerate():
+        if t in before or t.daemon or t is threading.current_thread():
+            continue
+        if any(t.name.startswith(p) for p in THREAD_LEAK_ALLOWLIST):
+            continue
+        # teardown that is mid-exit gets a short grace join before
+        # being called a leak
+        t.join(timeout=2.0)
+        if t.is_alive():
+            out.append(t)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks(request):
+    """Fail any test that leaves a live non-daemon thread behind
+    (explicit allowlist above; opt out per-test with
+    ``@pytest.mark.thread_leak_ok`` and a comment saying why)."""
+    import threading
+    before = set(threading.enumerate())
+    yield
+    if request.node.get_closest_marker("thread_leak_ok"):
+        return
+    leaked = _leaked_nondaemon(before)
+    if leaked:
+        names = ", ".join(f"{t.name!r}" for t in leaked)
+        pytest.fail(
+            f"test leaked live non-daemon thread(s): {names} — join "
+            f"them (or shutdown their pool/server) before returning; "
+            f"see THREAD_LEAK_ALLOWLIST in conftest.py",
+            pytrace=False)
+
+
+@pytest.fixture
+def racecheck(tmp_path, request):
+    """Instrumented-lock harness (hetu_tpu/analysis/racecheck.py):
+    locks created inside the test are traced; on teardown the measured
+    acquisition-order graph is dumped to ``lockgraph_<test>.json`` (a
+    CI failure artifact) and asserted acyclic."""
+    from hetu_tpu.analysis.racecheck import racecheck as _rc
+    with _rc(name=request.node.name, assert_acyclic=False) as rc:
+        yield rc
+    path = tmp_path / f"lockgraph_{request.node.name}.json"
+    path.write_text(rc.to_json())
+    rc.assert_acyclic()
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """On test failure, copy any telemetry / black-box files the test's
@@ -41,7 +105,12 @@ def pytest_runtest_makereport(item, call):
     test ships its own post-mortem instead of just a log tail."""
     outcome = yield
     rep = outcome.get_result()
-    if rep.when != "call" or not rep.failed:
+    if rep.failed:
+        item._hetu_failed = True
+    # collect at TEARDOWN of a failed test (any phase): fixture-written
+    # artifacts — e.g. the racecheck lockgraph JSON, written (and its
+    # acyclicity asserted) in fixture finalization — exist only then
+    if rep.when != "teardown" or not getattr(item, "_hetu_failed", False):
         return
     dest_root = os.environ.get("HETU_TEST_ARTIFACTS")
     tmp = getattr(item, "funcargs", {}).get("tmp_path")
@@ -51,7 +120,8 @@ def pytest_runtest_makereport(item, call):
     import shutil
     patterns = ("trace_*.json", "flight_rank*.json", "hb_rank*.json",
                 "stacks_*.log", "metrics_rank*.jsonl", "oom_rank*.txt",
-                "health_rank*.jsonl", "health_lastgood_rank*.json")
+                "health_rank*.jsonl", "health_lastgood_rank*.json",
+                "lockgraph_*.json")
     found = []
     for pat in patterns:
         found += glob.glob(os.path.join(str(tmp), "**", pat),
