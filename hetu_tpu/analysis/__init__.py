@@ -28,12 +28,18 @@ Two codebase self-lints ride beside the graph passes: **jit_purity**
 (HTPxx — host impurity inside jit-traced bodies) and **concurrency**
 (HT6xx — lockset/lock-order/lifecycle verification of the threaded
 host runtime, with ``racecheck.py`` as its dynamic instrumented-lock
-twin).
+twin). The distributed plane gets the same treatment from **wire** +
+**protocol** (HT7xx — PS wire-contract checking across the C++/ctypes
+boundary, and small-scope consistency model checking of the
+BSP/staleness/retry/failover protocol); PS-backed graphs get the wire
+check inside :func:`analyze` too.
 
 Surfaces: ``Executor(validate="error"|"warn"|"off")``,
-``heturun --preflight``, ``python -m hetu_tpu.analysis`` (zoo CLI),
-``python -m hetu_tpu.analysis.jit_purity`` and
-``python -m hetu_tpu.analysis.concurrency`` (codebase self-lints), and
+``heturun --preflight``, ``python -m hetu_tpu.analysis`` (zoo CLI;
+``--all`` aggregates every pass with one merged report),
+``python -m hetu_tpu.analysis.jit_purity``,
+``python -m hetu_tpu.analysis.concurrency`` and
+``python -m hetu_tpu.analysis.protocol`` (codebase self-lints), and
 a graphboard finding overlay. See ``docs/analysis.md``.
 """
 from __future__ import annotations
@@ -48,9 +54,11 @@ from .sharding import sharding_pass
 from .deadlock import deadlock_pass
 from .memory import memory_pass, check_compiled
 from .overlap import overlap_pass, RunLoopAdvisor
+from .findings import suppressed
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
-           "emit", "provenance", "analyze", "finish_preflight",
+           "emit", "provenance", "suppressed", "analyze",
+           "finish_preflight",
            "shape_pass", "lint_pass", "frozen_graph_pass",
            "sharding_pass", "deadlock_pass", "memory_pass",
            "overlap_pass", "RunLoopAdvisor",
@@ -118,6 +126,17 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
     _guard("memory", memory_pass, topo, shapes, report,
            budget=hbm_budget)
     _guard("overlap", overlap_pass, topo, report, config=config)
+    # PS-backed graphs will drive the native wire protocol: cross-check
+    # the C++/ctypes contract (HT701/HT702) before the first RPC. The
+    # parse is cached per process, so repeated preflights cost a dict
+    # lookup; the full consistency model checker stays on the CLI
+    # (python -m hetu_tpu.analysis.protocol).
+    def _wire_if_ps():
+        from .overlap import _ps_backed
+        if _ps_backed(topo):
+            from .wire import wire_pass
+            wire_pass(report)
+    _guard("protocol", _wire_if_ps)
     if frozen:
         _guard("frozen", frozen_graph_pass, topo, report)
     return report

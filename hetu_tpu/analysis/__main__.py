@@ -5,11 +5,42 @@ Builds each registered zoo graph with canonical feed shapes, runs every
 static pass, and prints per-model findings. Exit status 1 when any
 model has errors — the CI preflight job's gate. ``--jit-purity`` chains
 the codebase self-lint in the same invocation.
+
+``--all`` is the aggregate driver: zoo preflight + jit-purity +
+concurrency + protocol (wire contract and consistency model checking)
+in one invocation with a single merged report and exit code — the CI
+``analysis`` job, which uploads the merged JSON (``--out``) as its
+artifact. Per-pass gates keep their own semantics (zoo/jit-purity gate
+on errors; concurrency/protocol gate on ANY unsuppressed finding).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+
+
+def _run_zoo(names, json_out, hbm_budget, quiet=False):
+    from . import analyze, zoo
+    failed = []
+    models = {}
+    for name in names:
+        eval_nodes, feed_shapes = zoo.build(name)
+        report = analyze(eval_nodes, feed_shapes=feed_shapes,
+                         hbm_budget=hbm_budget)
+        status = "FAIL" if report.errors else "ok"
+        models[name] = report
+        if not quiet:
+            print(f"== {name}: {status} ({len(report.errors)} errors, "
+                  f"{len(report.warnings)} warnings)")
+            if json_out:
+                print(report.to_json())
+            else:
+                for f in report.errors + report.warnings:
+                    print("   " + str(f))
+        if report.errors:
+            failed.append(name)
+    return models, failed
 
 
 def main(argv=None):
@@ -28,9 +59,16 @@ def main(argv=None):
                              "$HETU_HBM_BUDGET or the device limit)")
     parser.add_argument("--jit-purity", action="store_true",
                         help="also run the jit-purity codebase lint")
+    parser.add_argument("--all", action="store_true",
+                        help="aggregate driver: zoo preflight + "
+                             "jit-purity + concurrency + protocol with "
+                             "one merged report and exit code")
+    parser.add_argument("--out", default=None, metavar="FILE",
+                        help="with --all: write the merged JSON report "
+                             "here (the CI artifact)")
     args = parser.parse_args(argv)
 
-    from . import analyze, zoo
+    from . import zoo
     if args.list:
         print("\n".join(sorted(zoo.ZOO)))
         return 0
@@ -41,22 +79,10 @@ def main(argv=None):
         parser.error(f"unknown zoo model(s) {unknown}; "
                      f"--list shows the registry")
 
-    failed = []
-    for name in names:
-        eval_nodes, feed_shapes = zoo.build(name)
-        report = analyze(eval_nodes, feed_shapes=feed_shapes,
-                         hbm_budget=args.hbm_budget)
-        status = "FAIL" if report.errors else "ok"
-        print(f"== {name}: {status} ({len(report.errors)} errors, "
-              f"{len(report.warnings)} warnings)")
-        if args.json:
-            print(report.to_json())
-        else:
-            for f in report.errors + report.warnings:
-                print("   " + str(f))
-        if report.errors:
-            failed.append(name)
+    if args.all:
+        return _main_all(names, args)
 
+    models, failed = _run_zoo(names, args.json, args.hbm_budget)
     rc = 0
     if failed:
         print(f"preflight: {len(failed)}/{len(names)} zoo model(s) "
@@ -65,6 +91,66 @@ def main(argv=None):
     if args.jit_purity:
         from .jit_purity import main as purity_main
         rc = max(rc, purity_main([]))
+    return rc
+
+
+def _main_all(names, args):
+    import os
+    from .jit_purity import check_paths as jit_check
+    from .concurrency import check_paths as conc_check
+    from .findings import Report
+    from .protocol import protocol_pass
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sections = {}
+    gates = {}
+
+    models, failed = _run_zoo(names, False, args.hbm_budget,
+                              quiet=True)
+    sections["zoo"] = {n: json.loads(r.to_json())
+                       for n, r in models.items()}
+    gates["zoo"] = 1 if failed else 0
+
+    jit = jit_check([pkg])
+    sections["jit_purity"] = json.loads(jit.to_json())
+    gates["jit_purity"] = 1 if jit.errors else 0
+
+    conc = conc_check([pkg])
+    sections["concurrency"] = json.loads(conc.to_json())
+    gates["concurrency"] = 1 if len(conc) else 0
+
+    proto = Report()
+    stats = protocol_pass(proto)
+    sections["protocol"] = json.loads(proto.to_json())
+    sections["protocol"]["model"] = stats
+    gates["protocol"] = 1 if len(proto) else 0
+
+    rc = max(gates.values())
+    merged = {"ok": rc == 0, "gates": gates, "sections": sections}
+    if args.json:
+        print(json.dumps(merged, indent=2))
+    else:
+        print(f"analysis --all: zoo {len(names) - len(failed)}/"
+              f"{len(names)} clean"
+              + (f" (failed: {', '.join(failed)})" if failed else "")
+              + f"; jit-purity {len(jit.errors)} error(s); "
+              f"concurrency {len(conc)} finding(s); protocol "
+              f"{len(proto)} finding(s), {stats['states']} model "
+              f"states explored")
+        for name, rep in models.items():
+            for f in rep.errors:
+                print(f"   zoo/{name}: {f}")
+        for rep in (jit, conc, proto):
+            for f in rep.findings:
+                print("   " + str(f))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        # stderr: --json keeps stdout a single parseable document
+        print(f"merged report written to {args.out}", file=sys.stderr)
+    if rc:
+        print("analysis --all: FAILED — fix or ht-ok-annotate the "
+              "findings above", file=sys.stderr)
     return rc
 
 

@@ -47,10 +47,12 @@ HT606  warn   async-signal-unsafe work — lock acquisition or file IO —
               inside an installed signal handler
 =====  =====  ==============================================================
 
-A line containing ``# lock-ok`` suppresses its findings; the annotated
-form ``# lock-ok: HT603 <reason>`` suppresses only that code and is the
-house style (the reason is the review artifact). For multi-site
-findings (HT601/HT602) the annotation may sit on any involved line.
+A line containing ``# ht-ok`` (or the historical ``# lock-ok`` alias)
+suppresses its findings; the annotated form ``# ht-ok: HT603 <reason>``
+suppresses only that code and is the house style (the reason is the
+review artifact — the shared :func:`~.findings.suppressed` helper makes
+every pass's waivers one grep surface). For multi-site findings
+(HT601/HT602) the annotation may sit on any involved line.
 
 CLI: ``python -m hetu_tpu.analysis.concurrency [paths...] [--json]``
 (default: the ``hetu_tpu`` package) — exit 1 when any unsuppressed
@@ -72,7 +74,7 @@ import os
 import re
 import sys
 
-from .findings import Finding, Report
+from .findings import Finding, Report, suppressed
 
 __all__ = ["check_source", "check_paths", "main"]
 
@@ -91,7 +93,6 @@ _HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler",
                        "SimpleHTTPRequestHandler", "BaseRequestHandler",
                        "StreamRequestHandler"}
 _EVENT_HINTS = {"event", "ev", "done", "stop", "ready"}
-_LOCK_OK_RE = re.compile(r"HT6\d\d")
 _MAIN = "main"
 
 
@@ -641,14 +642,9 @@ def _transitive_acquires(mod):
 # ---------------------------------------------------------------------------
 
 def _suppressed(lines, lineno, code):
-    if not (0 < lineno <= len(lines)):
-        return False
-    line = lines[lineno - 1]
-    i = line.find("# lock-ok")
-    if i < 0:
-        return False
-    codes = _LOCK_OK_RE.findall(line[i:])
-    return not codes or code in codes
+    # shared helper (findings.suppressed): canonical ``# ht-ok`` plus
+    # the historical ``# lock-ok`` alias this pass introduced
+    return suppressed(lines, lineno, code, markers=("ht-ok", "lock-ok"))
 
 
 def _emit(mod, lines, report):

@@ -18,11 +18,49 @@ from __future__ import annotations
 
 import contextlib
 import json
+import re
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
-           "emit", "provenance", "SEVERITIES"]
+           "emit", "provenance", "suppressed", "SEVERITIES"]
 
 SEVERITIES = ("error", "warn", "info")
+
+# ---------------------------------------------------------------------------
+# suppression comments: one grep surface for every waived finding
+# ---------------------------------------------------------------------------
+
+# an HT finding code: HT601, HT702, HTP01, HT001, ...
+_SUPPRESS_CODE_RE = re.compile(r"HT[A-Z]?\d+")
+
+# canonical marker + per-pass aliases kept for existing annotations
+SUPPRESS_MARKERS = ("ht-ok", "jit-ok", "lock-ok")
+
+
+def suppressed(lines, lineno, code=None, markers=SUPPRESS_MARKERS):
+    """Shared suppression-comment check for every source-level pass.
+
+    True when source line ``lineno`` (1-based, ``lines`` =
+    ``src.splitlines()``) carries a suppression marker that waives
+    ``code``. The house style is ``# ht-ok: <CODE> <reason>`` — the
+    annotated form suppresses only that code (the reason is the review
+    artifact); a bare marker suppresses every finding on the line.
+    ``// ht-ok`` works the same way in C/C++ sources (the wire-contract
+    pass lints the native PS files). ``jit-ok`` and ``lock-ok`` are
+    kept as pass-local aliases so existing annotations stay valid;
+    ``grep -rn 'ht-ok\\|jit-ok\\|lock-ok'`` is the one audit surface.
+    """
+    if not (0 < lineno <= len(lines)):
+        return False
+    line = lines[lineno - 1]
+    for marker in markers:
+        for lead in ("# ", "#", "// ", "//"):
+            i = line.find(lead + marker)
+            if i < 0:
+                continue
+            codes = _SUPPRESS_CODE_RE.findall(line[i:])
+            if not codes or code is None or code in codes:
+                return True
+    return False
 
 
 def provenance(node):

@@ -21,8 +21,11 @@ HTP10  host ``numpy`` call (fine for static shape math; worth
 HTP20  Python ``if``/``while`` on a traced function parameter
        (use ``lax.cond`` / ``jnp.where``)                     warn
 
-A line ending in ``# jit-ok`` (optionally with a reason) suppresses its
-findings — for host math that is provably static at trace time.
+A line ending in ``# ht-ok`` / ``# jit-ok`` (optionally with a code and
+reason, house style ``# ht-ok: HTP20 <reason>``) suppresses its
+findings — for host math that is provably static at trace time. The
+check is the shared :func:`~.findings.suppressed` helper, so every
+pass's waivers share one grep surface.
 
 CLI: ``python -m hetu_tpu.analysis.jit_purity [paths...]`` (default:
 the ``hetu_tpu`` package) — exit 1 when errors exist; wired into CI as
@@ -39,7 +42,7 @@ import ast
 import os
 import sys
 
-from .findings import Finding, Report
+from .findings import Finding, Report, suppressed
 
 __all__ = ["check_source", "check_paths", "main"]
 
@@ -126,19 +129,14 @@ def _collect_traced_defs(tree):
     return traced
 
 
-def _suppressed(src_lines, lineno):
-    if 0 < lineno <= len(src_lines):
-        return "# jit-ok" in src_lines[lineno - 1]
-    return False
-
-
 def _check_body(fn, path, src_lines, report):
     params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
                               + fn.args.kwonlyargs)}
     params.discard("self")
 
     def add(code, sev, msg, node):
-        if _suppressed(src_lines, node.lineno):
+        if suppressed(src_lines, node.lineno, code,
+                      markers=("ht-ok", "jit-ok")):
             return
         report.findings.append(Finding(
             code, sev, msg, where=f"{path}:{node.lineno}",

@@ -1,0 +1,594 @@
+"""Pass 7b — PS consistency model checker (HT703-HT706) + CLI driver.
+
+``wire.py`` proves the two sides of the PS plane *frame* requests the
+same way; this module proves the protocol built on those frames keeps
+its consistency promises. Abstract worker/server/cache state machines
+mirror the real drivers — async pushes through the push pool
+(``ps/runtime.py``), the BSP barrier, the bounded-staleness cache sync
+(``ps_cache.cc`` / ``device_cache.py``), PR 7's speculative-pull
+revalidation, the client's reconnect-and-retry loop
+(``ps_client.cc call()``), and the drain-then-checkpoint save contract
+— and a DFS with state hashing exhaustively explores every
+interleaving over small scopes (2 workers x 2 servers x short
+push/pull/barrier/sync programs; the same bounded-exhaustive philosophy
+as ``deadlock.py``'s schedule replay, TLA+-style small-scope checking).
+A consistency bug that would surface once a week under production load
+is a counterexample trace here, before launch:
+
+=====  =====  ==============================================================
+HT703  error  BSP read misses a pre-barrier acknowledged push — the
+              barrier did not establish the superstep frontier
+HT704  error  bounded staleness violated: a sync leaves a row more than
+              ``pull_bound`` versions behind, local pending updates
+              exceed ``push_bound``, or a speculative pull is consumed
+              without revalidating rows its own pushes dirtied
+HT705  error  a retried mutating RPC double-applies: the handler
+              accumulates but is not guarded by the (worker, seq) dedup
+              (``check_and_record``) the retry loop relies on
+HT706  error  a modeled server kill+restart loses an acknowledged
+              update — the checkpoint/recovery contract does not cover
+              every acked push
+=====  =====  ==============================================================
+
+The model is *parameterized by the extracted wire contract*: HT705
+replays retries against exactly the handlers ``wire.parse_wire`` found
+(dedup-guarded or not), so dropping ``check_and_record`` from a server
+case flips the model red with that case's ``file:line``. HT706 is the
+executable spec for ROADMAP item 2's failover work: the canonical
+scenario passes because today's ``save()`` drains before
+checkpointing and kills are modeled after a covering checkpoint;
+``recovery_replays=True`` models the replay-acked-pushes recovery item
+2 must implement to survive kills at arbitrary points.
+
+CLI: ``python -m hetu_tpu.analysis.protocol [--json]`` — runs the wire
+pass plus every canonical scenario, reports the explored-state count,
+exits 1 on any unsuppressed finding. Suppression: ``# ht-ok: HT7xx
+<reason>`` on the finding's anchor line.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .findings import Report, suppressed
+from . import wire as _wire
+
+__all__ = ["Model", "explore", "canonical_scenarios", "check_protocol",
+           "protocol_pass", "main"]
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _anchor(relpath, pattern):
+    """file:line of the first source line containing ``pattern`` — the
+    real-code anchor a model-level finding points at."""
+    path = os.path.join(_PKG, relpath)
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if pattern in line:
+                    return path, i
+    except OSError:
+        pass
+    return path, 1
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+class Model:
+    """One small-scope scenario: per-worker instruction programs over a
+    sharded table (row r on server ``r % nservers``), explored
+    exhaustively.
+
+    Instructions (tuples):
+
+    * ``("push", row, ss)``   — async accumulate push (+1) tagged with
+      its BSP superstep ``ss``; enqueued on the worker's in-flight
+      queue, delivered by a separate scheduler action (the push pool).
+    * ``("wait",)``           — ``client.wait``: enabled once the
+      worker's queue drained.
+    * ``("bar",)``            — the BSP barrier (server 0), releasing
+      when all workers arrive.
+    * ``("pull", row, ss)``   — synchronous read; under ``mode="bsp"``
+      checks the superstep frontier (HT703).
+    * ``("spec", row)``       — speculative SparsePull: snapshot the
+      row now, consume later.
+    * ``("use", row)``        — consume the speculative rows;
+      ``revalidate`` models run_step's dirty re-pull (HT704).
+    * ``("update", row)``     — cache-local gradient accumulate;
+      flushes at ``push_bound`` when ``flush_on_bound`` (HT704).
+    * ``("sync", row, bound)``— SyncEmbedding under ``bound``;
+      ``sync_slack`` models a broken server bound check (HT704).
+    * ``("save",)``           — drain-then-checkpoint (``save_drains``
+      models skipping the drain).
+    * ``("kill", server)``    — SIGKILL + restart from the last
+      checkpoint; ``recovery_replays`` models item-2-style replay of
+      acked pushes (HT706).
+
+    State is a flat hashable tuple; ``explore`` DFS-walks every
+    scheduler interleaving (worker steps x push deliveries x retry
+    branches) with memoization.
+    """
+
+    def __init__(self, name, programs, *, nservers=2, rows=2,
+                 mode="asp", retries=False, dedup=True,
+                 unsafe_site=None, push_bound=2, flush_on_bound=True,
+                 sync_slack=0, revalidate=True, save_drains=True,
+                 recovery_replays=False):
+        self.name = name
+        self.programs = [tuple(p) for p in programs]
+        self.nworkers = len(programs)
+        self.nservers = nservers
+        self.rows = rows
+        self.mode = mode
+        self.retries = retries
+        self.dedup = dedup
+        self.unsafe_site = unsafe_site       # (path, line) for HT705
+        self.push_bound = push_bound
+        self.flush_on_bound = flush_on_bound
+        self.sync_slack = sync_slack
+        self.revalidate = revalidate
+        self.save_drains = save_drains
+        self.recovery_replays = recovery_replays
+        # static superstep frontier for HT703: tags expected visible to
+        # a (row, ss) read = every push to that row on an earlier ss
+        self._expected = {}
+        for w, prog in enumerate(self.programs):
+            for pc, ins in enumerate(prog):
+                if ins[0] == "push":
+                    self._expected.setdefault(
+                        (ins[1],), []).append(((w, pc), ins[2]))
+
+    def expected(self, row, ss):
+        return {tag for tag, pss in self._expected.get((row,), ())
+                if pss < ss}
+
+    # -- state layout ---------------------------------------------------
+    # workers: tuple of (pc, inflight tags, spec, pending, cver)
+    # applied: tuple per row of ((tag, mult), ...)
+    # snapshot: applied-like or None
+    # barwait: frozenset of workers at the barrier
+    def initial(self):
+        w0 = (0, (), None, (0,) * self.rows, (0,) * self.rows)
+        return ((w0,) * self.nworkers,
+                ((),) * self.rows, None, frozenset())
+
+    @staticmethod
+    def _ver(row_applied):
+        return sum(m for _t, m in row_applied)
+
+    @staticmethod
+    def _tags(row_applied):
+        return {t for t, _m in row_applied}
+
+    def _apply(self, applied, row, tag, mult):
+        d = dict(applied[row])
+        d[tag] = d.get(tag, 0) + mult
+        new_row = tuple(sorted(d.items()))
+        return applied[:row] + (new_row,) + applied[row + 1:], d[tag]
+
+    # -- successors -----------------------------------------------------
+    def successors(self, st, violate):
+        """Yield (action label, next state); report invariant breaks
+        through ``violate(code, message)``."""
+        workers, applied, snapshot, barwait = st
+
+        def set_w(w, ws):
+            return workers[:w] + (ws,) + workers[w + 1:]
+
+        # scheduler: deliver any element of any worker's in-flight
+        # queue (the push pool runs 2 threads — submission order is
+        # NOT delivery order, so the model must not assume FIFO)
+        for w, (pc, inflight, spec, pend, cver) in enumerate(workers):
+            for qi, tag in enumerate(inflight):
+                mult = tag[2]
+                row = self.programs[w][tag[1]][1]
+                new_applied, _got = self._apply(applied, row,
+                                                (tag[0], tag[1]), mult)
+                ws = (pc, inflight[:qi] + inflight[qi + 1:], spec,
+                      pend, cver)
+                yield (f"deliver w{w}#{tag[1]}",
+                       (set_w(w, ws), new_applied, snapshot, barwait))
+                if self.retries and not self.dedup:
+                    # the reconnect-and-retry loop re-sends the same
+                    # (worker, seq) after a lost response; a dedup-
+                    # guarded handler makes the retry a no-op (same
+                    # state — pruned by the visited set), an unguarded
+                    # one double-applies
+                    violate(
+                        "HT705",
+                        f"[{self.name}] retried push w{w}#{tag[1]} "
+                        f"applied twice: the handler accumulates but "
+                        f"has no (worker, seq) dedup — a lost response "
+                        f"turns into a double gradient apply")
+
+        for w, (pc, inflight, spec, pend, cver) in enumerate(workers):
+            prog = self.programs[w]
+            if pc >= len(prog) or w in barwait:
+                continue
+            ins = prog[pc]
+            kind = ins[0]
+            label = f"w{w}:{kind}" + (f" r{ins[1]}" if len(ins) > 1
+                                      and isinstance(ins[1], int) else "")
+
+            if kind == "push":
+                tag = (w, pc, 1)          # (worker, site, mult)
+                ws = (pc + 1, inflight + (tag,), spec, pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "wait":
+                if inflight:
+                    continue              # scheduler must deliver first
+                ws = (pc + 1, inflight, spec, pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "bar":
+                if len(barwait | {w}) >= self.nworkers:
+                    new_workers = tuple(
+                        (p + 1, i, s, pe, cv) if (ww in barwait
+                                                  or ww == w)
+                        else (p, i, s, pe, cv)
+                        for ww, (p, i, s, pe, cv) in enumerate(workers))
+                    yield label, (new_workers, applied, snapshot,
+                                  frozenset())
+                else:
+                    yield label, (workers, applied, snapshot,
+                                  barwait | {w})
+
+            elif kind == "pull":
+                row, ss = ins[1], ins[2]
+                if self.mode == "bsp":
+                    missing = self.expected(row, ss) \
+                        - self._tags(applied[row])
+                    if missing:
+                        names = ", ".join(
+                            f"w{t[0]}#{t[1]}" for t in sorted(missing))
+                        violate(
+                            "HT703",
+                            f"[{self.name}] BSP read of row {row} in "
+                            f"superstep {ss} (w{w}) misses pre-barrier "
+                            f"push(es) {names} — the program reads "
+                            f"before the barrier established the "
+                            f"superstep frontier")
+                        continue
+                ws = (pc + 1, inflight, spec, pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "spec":
+                row = ins[1]
+                ws = (pc + 1, inflight,
+                      (row, tuple(sorted(self._tags(applied[row])))),
+                      pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "use":
+                row = ins[1]
+                own = {(w, p) for p in range(pc)
+                       if prog[p][0] == "push" and prog[p][1] == row}
+                obs = set(spec[1]) if spec is not None else set()
+                dirty = own - obs
+                if self.revalidate and dirty:
+                    if inflight:
+                        continue          # _flush_pushes blocks first
+                    obs = self._tags(applied[row])
+                missing = own - obs
+                if missing:
+                    names = ", ".join(f"w{t[0]}#{t[1]}"
+                                      for t in sorted(missing))
+                    violate(
+                        "HT704",
+                        f"[{self.name}] speculative pull of row {row} "
+                        f"consumed without revalidation: the fed rows "
+                        f"miss this worker's own acked push(es) "
+                        f"{names} — the overlapped pull must re-pull "
+                        f"ids dirtied since issue")
+                    continue
+                ws = (pc + 1, inflight, None, pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "update":
+                row = ins[1]
+                n = pend[row] + 1
+                new_inflight = inflight
+                if self.flush_on_bound and n >= self.push_bound:
+                    new_inflight = inflight + ((w, pc, n),)
+                    n = 0
+                if n > self.push_bound:
+                    violate(
+                        "HT704",
+                        f"[{self.name}] row {row} holds {n} local "
+                        f"updates with push_bound={self.push_bound} — "
+                        f"the cache never flushed at the bound, so "
+                        f"other workers observe staleness past the "
+                        f"contract")
+                    continue
+                new_pend = pend[:row] + (n,) + pend[row + 1:]
+                ws = (pc + 1, new_inflight, spec, new_pend, cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "sync":
+                row, bound = ins[1], ins[2]
+                ver = self._ver(applied[row])
+                if ver - cver[row] > bound + self.sync_slack:
+                    new_cver = cver[:row] + (ver,) + cver[row + 1:]
+                else:
+                    new_cver = cver
+                if ver - new_cver[row] > bound:
+                    violate(
+                        "HT704",
+                        f"[{self.name}] SyncEmbedding(bound={bound}) "
+                        f"left row {row} {ver - new_cver[row]} "
+                        f"versions stale — the server's staleness "
+                        f"comparison does not honour the bound")
+                    continue
+                ws = (pc + 1, inflight, spec, pend, new_cver)
+                yield label, (set_w(w, ws), applied, snapshot, barwait)
+
+            elif kind == "save":
+                if self.save_drains and any(
+                        ws2[1] for ws2 in workers):
+                    continue              # drain() joins pushes first
+                ws = (pc + 1, inflight, spec, pend, cver)
+                yield label, (set_w(w, ws), applied, applied, barwait)
+
+            elif kind == "kill":
+                server = ins[1]
+                restored = list(snapshot) if snapshot is not None \
+                    else [()] * self.rows
+                new_applied = tuple(
+                    restored[r] if r % self.nservers == server
+                    else applied[r] for r in range(self.rows))
+                if self.recovery_replays:
+                    # item-2 recovery: replay every acked push
+                    for r in range(self.rows):
+                        if r % self.nservers != server:
+                            continue
+                        merged = dict(new_applied[r])
+                        for t, m in applied[r]:
+                            merged.setdefault(t, m)
+                        new_applied = new_applied[:r] + (
+                            tuple(sorted(merged.items())),
+                        ) + new_applied[r + 1:]
+                lost = []
+                for r in range(self.rows):
+                    lost.extend(sorted(self._tags(applied[r])
+                                       - self._tags(new_applied[r])))
+                if lost:
+                    names = ", ".join(f"w{t[0]}#{t[1]}"
+                                      for t in sorted(set(lost)))
+                    violate(
+                        "HT706",
+                        f"[{self.name}] server {server} kill+restart "
+                        f"loses acknowledged push(es) {names}: the "
+                        f"last checkpoint does not cover them and the "
+                        f"modeled recovery replays nothing — a worker "
+                        f"was told its update landed, and it is gone")
+                    continue
+                ws = (pc + 1, inflight, spec, pend, cver)
+                yield label, (set_w(w, ws), new_applied, snapshot,
+                              barwait)
+
+            else:                         # pragma: no cover
+                raise ValueError(f"unknown instruction {ins!r}")
+
+
+def explore(model, max_states=200000):
+    """DFS over every interleaving; returns (states_explored,
+    violations, truncated) where violations is {code: (message,
+    trace)} keeping the first counterexample per code and
+    ``truncated`` flags a search stopped at ``max_states`` — an
+    incomplete exploration must never read as "proved clean"."""
+    seen = set()
+    violations = {}
+    stack = [(model.initial(), ())]
+
+    while stack and len(seen) < max_states:
+        st, path = stack.pop()
+        if st in seen:
+            continue
+        seen.add(st)
+
+        def violate(code, message, _path=path):
+            if code not in violations:
+                violations[code] = (message, _path)
+
+        for label, nxt in model.successors(st, violate):
+            if nxt not in seen:
+                stack.append((nxt, path + (label,)))
+    return len(seen), violations, bool(stack)
+
+
+# ---------------------------------------------------------------------------
+# canonical scenarios: the 2 workers x 2 servers scope the CLI holds
+# the repo to
+# ---------------------------------------------------------------------------
+
+def _bsp_programs(reorder=False):
+    """Two BSP supersteps per worker, two pushes per superstep (both
+    table shards — so each step has multiple RPCs racing through the
+    2-thread push pool, like a real multi-table step): push, drain,
+    barrier, read the *other* worker's rows. ``reorder=True`` is the
+    HT703 fixture — the superstep-1 read issued before the superstep-0
+    barrier (the barrier-skipping program)."""
+    progs = []
+    for w in (0, 1):
+        other = 1 - w
+        clean = [("push", w, 0), ("push", other, 0), ("wait",),
+                 ("bar",),
+                 ("pull", other, 1), ("pull", w, 1),
+                 ("push", w, 1), ("push", other, 1), ("wait",),
+                 ("bar",),
+                 ("pull", w, 2), ("pull", other, 2)]
+        broken = [("push", w, 0), ("push", other, 0), ("wait",),
+                  ("pull", other, 1), ("pull", w, 1),
+                  ("bar",),
+                  ("push", w, 1), ("push", other, 1), ("wait",),
+                  ("bar",),
+                  ("pull", w, 2), ("pull", other, 2)]
+        progs.append(broken if (reorder and w == 0) else clean)
+    return progs
+
+
+def canonical_scenarios(spec=None, **overrides):
+    """The scenario suite ``python -m hetu_tpu.analysis.protocol``
+    explores; every one must come back clean on the unmodified repo.
+    ``overrides`` (e.g. ``revalidate=False``) mutate every scenario —
+    the injected-bug fixtures in tests drive them."""
+    try:
+        spec = spec or _wire.parse_wire()
+    except OSError:
+        spec = None
+    unsafe = spec.retry_unsafe_ops() if spec is not None else []
+    dedup = not unsafe
+    unsafe_site = (unsafe[0].server_cases[0] if unsafe
+                   and unsafe[0].server_cases else None)
+
+    def mk(name, programs, **kw):
+        kw.update(overrides)
+        return Model(name, programs, **kw)
+
+    return [
+        # HT703: two-superstep BSP over the 2x2 scope, with retries on
+        # so the barrier must also hold under duplicate delivery
+        mk("bsp_2x2", _bsp_programs(), mode="bsp", retries=True,
+           dedup=dedup, unsafe_site=unsafe_site),
+        # HT705: concurrent accumulate pushes under the retry loop,
+        # dedup taken from the parsed wire contract; ASP (no waits
+        # between pushes), so up to 3 RPCs race per worker
+        mk("retry_dedup",
+           [[("push", 0, 0), ("push", 1, 0), ("push", 0, 0),
+             ("wait",), ("pull", 0, 0)],
+            [("push", 0, 0), ("push", 1, 0), ("push", 1, 0),
+             ("wait",), ("pull", 1, 0)]],
+           retries=True, dedup=dedup, unsafe_site=unsafe_site),
+        # HT704: bounded-staleness sync racing ASP pushes on both shards
+        mk("staleness_sync",
+           [[("push", 0, 0), ("push", 0, 0), ("push", 1, 0),
+             ("wait",), ("push", 0, 0), ("wait",)],
+            [("sync", 0, 1), ("sync", 1, 1), ("sync", 0, 1),
+             ("sync", 1, 0), ("sync", 0, 0)]]),
+        # HT704: cache-local update accumulation against push_bound
+        mk("staleness_push",
+           [[("update", 0), ("update", 0), ("update", 0),
+             ("wait",)],
+            [("sync", 0, 2)]],
+           push_bound=2),
+        # HT704: PR 7 speculative pull with own pushes in flight on
+        # both shards
+        mk("spec_pull",
+           [[("push", 0, 0), ("push", 1, 0), ("spec", 0),
+             ("push", 0, 0), ("use", 0), ("spec", 1), ("push", 1, 0),
+             ("use", 1), ("wait",)],
+            [("push", 1, 0), ("push", 0, 0), ("wait",)]]),
+        # HT706: drain-then-checkpoint save, then a kill of server 0 —
+        # the acked pre-save pushes (both shards in flight) must
+        # survive the restart
+        mk("failover",
+           [[("push", 0, 0), ("push", 1, 0), ("wait",), ("bar",),
+             ("save",), ("kill", 0), ("pull", 0, 1), ("pull", 1, 1)],
+            [("push", 0, 0), ("push", 1, 0), ("wait",), ("bar",)]]),
+    ]
+
+
+# real-code anchors for model-level findings (the invariant lives in
+# the model; the contract it checks lives at these sites)
+_ANCHORS = {
+    "HT703": ("ps/runtime.py", "client.barrier()"),
+    "HT704": ("ps/runtime.py", "def _settle_spec_pull"),
+    "HT705": ("ps/native/ps_server.cc", "bool check_and_record"),
+    "HT706": ("ps/runtime.py", "def save"),
+}
+
+
+def check_protocol(report, spec=None, scenarios=None, **overrides):
+    """Run the model scenarios; returns stats
+    ``{"states": int, "scenarios": int, "violations": int}``."""
+    scenarios = scenarios if scenarios is not None \
+        else canonical_scenarios(spec, **overrides)
+    total = 0
+    nviol = 0
+    for model in scenarios:
+        states, violations, truncated = explore(model)
+        total += states
+        if truncated:
+            # an under-explored scenario must not pass as verified:
+            # HT700 gates like every other finding (raise max_states
+            # or shrink the scenario deliberately)
+            nviol += 1
+            report.add(
+                "HT700", "warn",
+                f"[{model.name}] state-space exploration truncated at "
+                f"{states} states — coverage is incomplete, a "
+                f"violation may hide in the unexplored region; raise "
+                f"explore(max_states=) or shrink the scenario",
+                scenario=model.name, states=states)
+        for code, (message, trace) in sorted(violations.items()):
+            site = None
+            if code == "HT705" and model.unsafe_site:
+                site = model.unsafe_site
+            if site is None:
+                site = _anchor(*_ANCHORS[code])
+            path, line = site
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            if suppressed(lines, line, code, markers=("ht-ok",)):
+                continue
+            nviol += 1
+            tail = "; ".join(trace[-8:])
+            report.add(code, "error",
+                       message + f" (counterexample: ...{tail})",
+                       where=f"{os.path.relpath(path)}:{line}",
+                       scenario=model.name, states=states)
+    return {"states": total, "scenarios": len(scenarios),
+            "violations": nviol}
+
+
+def protocol_pass(report, native_dir=None, py_dir=None,
+                  model_check=True):
+    """Wire contract (HT701/HT702) plus, when ``model_check``, the
+    consistency scenarios (HT703-HT706). Returns the stats dict."""
+    spec = _wire.wire_pass(report, native_dir=native_dir,
+                           py_dir=py_dir)
+    stats = {"states": 0, "scenarios": 0, "violations": 0}
+    if model_check:
+        stats = check_protocol(report, spec=spec)
+    return stats
+
+
+def main(argv=None):
+    import argparse
+    import json as _json
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.analysis.protocol",
+        description="PS distributed-protocol verifier: wire-contract "
+                    "checking (HT701/HT702) + small-scope consistency "
+                    "model checking (HT703-HT706)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--no-model", action="store_true",
+                        help="wire-contract checks only (skip the "
+                             "state-space exploration)")
+    args = parser.parse_args(argv)
+    report = Report()
+    stats = protocol_pass(report, model_check=not args.no_model)
+    if args.json:
+        doc = _json.loads(report.to_json())
+        doc["model"] = stats
+        print(_json.dumps(doc, indent=2))
+    else:
+        print(report.to_text())
+        print(f"model checker: {stats['states']} states explored "
+              f"across {stats['scenarios']} scenarios "
+              f"({stats['violations']} violation(s))")
+    # ANY unsuppressed finding gates (concurrency-lint precedent): a
+    # warn here is silent protocol rot, not style
+    return 1 if len(report) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

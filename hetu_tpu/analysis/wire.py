@@ -1,0 +1,611 @@
+"""Pass 7a — PS wire-contract checker (HT701/HT702).
+
+The parameter-server plane crosses three unchecked boundaries: the C++
+``Op`` enum and length-prefixed framing (``ps/native/ps_common.h``),
+the client encoders / server handlers that serialize it
+(``ps_client.cc`` / ``ps_server.cc`` / ``ps_cache.cc``), and the ctypes
+bridge that Python calls through (``ps/native_lib.py``,
+``cstable.py``, call sites in ``ps/client.py``). Nothing ties them
+together: add a field to a request writer and the server reader decodes
+garbage rows with status 0; drop a ``case`` and the client burns its
+whole retry budget against ``-100``; re-order a ctypes prototype and
+pointers reinterpret silently. This pass extracts the contract from all
+three layers (pattern-level parse of the small, idiomatic native
+sources — the same spirit as ``jit_purity.py``'s AST lint, and exactly
+as fragile as the idioms it matches, which the round-trip tests in
+``tests/test_wire_roundtrip.py`` pin against a live server) and
+cross-checks:
+
+=====  =====  ==============================================================
+HT701  error  a client-encoded op has no server handler (the client
+              would retry forever against status -100)
+HT701  warn   dead wire surface: an ``Op`` with a handler but no client
+              encoder, or an ``extern "C"`` symbol never ctypes-bound
+              (and vice versa)
+HT702  error  schema drift: the client's request field sequence differs
+              from the server's read sequence, the server's response
+              framing differs from what the client decodes, or a ctypes
+              prototype disagrees with the C signature (arity or
+              pointer/scalar types)
+=====  =====  ==============================================================
+
+The extraction also classifies each server handler — mutating?
+accumulating (``apply_dense``/``apply_sparse``)? dedup-guarded
+(``check_and_record`` on the ``(worker, seq)`` identity)? — which is
+the input the consistency model checker (``protocol.py``) uses for its
+HT705 retry-idempotence invariant: the model replays the client's
+reconnect-and-retry loop against exactly the handlers this parse found.
+
+Suppression: ``// ht-ok: HT701 <reason>`` on the involved line (C++
+sources use ``//``; the shared :func:`~.findings.suppressed` helper
+accepts both comment leaders).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Report, suppressed
+
+__all__ = ["WireOp", "WireSpec", "parse_wire", "wire_pass",
+           "rpc_contract", "NATIVE_DIR"]
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ps", "native")
+
+# field kinds a Writer emits / a Reader consumes, in framing order.
+# floats/longs/str are length-prefixed composites; scalars are raw.
+_FIELD_RE = re.compile(
+    r"\b(?:w|out|rd)\.(u32|i32|i64|u64|f32|f64|floats|longs|str|raw)\s*\(")
+_ENUM_RE = re.compile(r"^\s*k(\w+)\s*=\s*(\d+)\s*,")
+_CASE_RE = re.compile(r"^\s*case\s+Op::k(\w+)\s*:")
+_CALL_RE = re.compile(r"\bcall\s*\(\s*([^,]+),\s*Op::k(\w+)\s*,")
+_GUARD_RE = re.compile(r"op\s*==\s*Op::k(\w+)")
+# an extern "C" function definition: ret name(args) {   (args may span
+# lines; a trailing ';' instead of '{' is a declaration and skipped)
+_CFN_RE = re.compile(
+    r"^\s*(?:extern\s+\"C\"\s+)?"
+    r"(void|int|uint64_t|int64_t)\s+(\w+)\s*\(([^)]*)\)\s*(\{|;)",
+    re.M | re.S)
+
+# C parameter type -> canonical ctypes-equivalence token
+_CTYPE_OF = {
+    "int": "c_int", "int32_t": "c_int", "int64_t": "c_int64",
+    "uint64_t": "c_uint64", "double": "c_double", "float": "c_float",
+    "const char*": "c_char_p", "char*": "c_char_p",
+    "const float*": "ptr:c_float", "float*": "ptr:c_float",
+    "const int64_t*": "ptr:c_int64", "int64_t*": "ptr:c_int64",
+}
+
+# python RPC kind (telemetry/flight ``ps`` events, ps/client.py) ->
+# wire op; blocking=True means the caller synchronously reads the
+# response, so a pending entry is a thread stuck in read_full()
+RPC_KIND_OPS = {
+    "ps_pull": ("DensePull", True),
+    "ps_push": ("DensePush", False),
+    "ps_dd_pushpull": ("DDPushPull", False),
+    "ps_sparse_push": ("SparsePush", False),
+    "ps_sparse_pull": ("SparsePull", True),
+    "ps_sync_embedding": ("SyncEmbedding", True),
+    "ps_push_embedding": ("PushEmbedding", False),
+    "ps_barrier": ("Barrier", True),
+}
+
+
+class WireOp:
+    """One wire op's contract, merged across the three layers."""
+
+    __slots__ = ("name", "value", "enum_line", "server_cases",
+                 "server_reads", "server_writes", "mutating",
+                 "accumulating", "dedup_guarded", "client_sites")
+
+    def __init__(self, name, value, enum_line):
+        self.name = name
+        self.value = value
+        self.enum_line = enum_line            # line in ps_common.h
+        self.server_cases = []                # [(path, line)]
+        self.server_reads = []                # request field sequence
+        self.server_writes = []               # response field sequence
+        self.mutating = False
+        self.accumulating = False
+        self.dedup_guarded = False
+        # [{path, line, writes, reads, wants_resp}]
+        self.client_sites = []
+
+    def __repr__(self):
+        return (f"WireOp(k{self.name}={self.value}, "
+                f"req={self.server_reads}, resp={self.server_writes})")
+
+
+class WireSpec:
+    """The parsed contract: ops + the ctypes boundary."""
+
+    def __init__(self, native_dir):
+        self.native_dir = native_dir
+        self.ops = {}             # name -> WireOp
+        self.c_functions = {}     # name -> {path, line, params, ret}
+        self.bindings = {}        # name -> {path, line, argtypes, restype}
+        self.py_calls = []        # [{path, line, name, nargs}]
+        self.sources = {}         # path -> splitlines() (suppression)
+
+    def op(self, name):
+        return self.ops.get(name)
+
+    def retry_unsafe_ops(self):
+        """Handlers the model checker must double-apply: accumulating
+        mutations not guarded by the (worker, seq) dedup."""
+        return [op for op in self.ops.values()
+                if op.accumulating and not op.dedup_guarded]
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _fields(text):
+    """Ordered Writer/Reader field kinds in a code region, with the
+    length-prefixed raw-buffer idiom (``out.i64(n)`` + ``out.buf.resize``
+    + memcpy/gather into the tail) collapsed to one ``floats`` — the
+    server's zero-copy way of writing what ``rd.floats`` decodes."""
+    out = []
+    for line in text.splitlines():
+        if "out.buf.resize(" in line and out and out[-1] == "i64":
+            out[-1] = "floats"
+            continue
+        for m in _FIELD_RE.finditer(line):
+            out.append(m.group(1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the Op enum (ps_common.h)
+# ---------------------------------------------------------------------------
+
+def _parse_enum(spec, path):
+    in_enum = False
+    for i, line in enumerate(spec.sources[path], 1):
+        if "enum class Op" in line:
+            in_enum = True
+            continue
+        if in_enum:
+            if "}" in line:
+                break
+            m = _ENUM_RE.match(line)
+            if m:
+                spec.ops[m.group(1)] = WireOp(m.group(1),
+                                              int(m.group(2)), i)
+
+
+# ---------------------------------------------------------------------------
+# layer 2a: server handlers (ps_server.cc handle() switch)
+# ---------------------------------------------------------------------------
+
+def _parse_server(spec, path):
+    lines = spec.sources[path]
+    # split the switch into case blocks; consecutive labels share one
+    cases = [(i, _CASE_RE.match(line).group(1))
+             for i, line in enumerate(lines, 1) if _CASE_RE.match(line)]
+    # the switch's closing brace bounds the LAST case's body — without
+    # it, the final case would absorb the rest of the file (trailing
+    # member declarations like `bar_gen_` misclassified a last-case
+    # handler as dedup-guarded)
+    switch_end = len(lines) + 1
+    if cases:
+        last_line, _ = cases[-1]
+        case_indent = len(lines[last_line - 1]) \
+            - len(lines[last_line - 1].lstrip())
+        for j in range(last_line, len(lines)):
+            line = lines[j]
+            if line.strip() == "}" and \
+                    len(line) - len(line.lstrip()) < case_indent:
+                switch_end = j + 1
+                break
+    for idx, (lineno, name) in enumerate(cases):
+        op = spec.ops.get(name)
+        if op is None:
+            continue
+        op.server_cases.append((path, lineno))
+        # the shared block body: from this label to the start of the
+        # NEXT group's body (labels with an empty gap fall through)
+        end = switch_end
+        for j in range(idx + 1, len(cases)):
+            between = "".join(lines[lineno:cases[j][0] - 1]).strip()
+            if between:                 # real code before that label
+                end = cases[j][0]
+                break
+        body_lines = lines[lineno:end - 1]
+        body = "\n".join(body_lines)
+
+        reads, writes = [], []
+        prev = ""
+        guard_ops = None
+        for line in body_lines:
+            # a response write under `if (op == Op::kX)` belongs to X
+            g = _GUARD_RE.search(line) or _GUARD_RE.search(prev)
+            only = g.group(1) if g else None
+            if "out.buf.resize(" in line and writes and \
+                    writes[-1][0] == "i64":
+                writes[-1] = ("floats", writes[-1][1])
+            for m in _FIELD_RE.finditer(line):
+                recv = m.group(0)
+                if recv.startswith("rd."):
+                    reads.append(m.group(1))
+                elif recv.startswith("out."):
+                    writes.append((m.group(1), only))
+            prev = line if line.strip() else prev
+        op.server_reads = reads
+        op.server_writes = [k for k, only in writes
+                            if only is None or only == name]
+        op.mutating = bool(re.search(
+            r"apply_dense|apply_sparse|memcpy\(t->data|std::fill\(t->data"
+            r"|blobs_\[|t->ver\[[^\]]+\]\s*\+=|store_\[id\]", body))
+        op.accumulating = bool(re.search(
+            r"apply_dense|apply_sparse", body))
+        op.dedup_guarded = ("check_and_record" in body
+                            or "bar_gen" in body)
+
+
+# ---------------------------------------------------------------------------
+# layer 2b: client encoders (ps_client.cc call sites)
+# ---------------------------------------------------------------------------
+
+def _parse_client(spec, path):
+    lines = spec.sources[path]
+    n = len(lines)
+    for i, line in enumerate(lines, 1):
+        m = _CALL_RE.search(line)
+        if not m:
+            continue
+        name = m.group(2)
+        op = spec.ops.get(name)
+        if op is None:
+            continue
+        # full call text (may span lines) to find the resp argument
+        call_txt = line
+        j = i
+        while call_txt.count("(") > call_txt.count(")") and j < n:
+            call_txt += lines[j]
+            j += 1
+        wants_resp = "&resp" in call_txt
+        # request: Writer ops since the nearest preceding `Writer w;`
+        w0 = None
+        for k in range(i - 1, max(0, i - 40), -1):
+            if re.search(r"\bWriter\s+w\s*;", lines[k - 1]):
+                w0 = k
+                break
+        writes = _fields("\n".join(lines[w0:i - 1])) if w0 else []
+        # response: Reader ops after `Reader rd(resp...)`, up to the
+        # next Writer/call (per-part loops re-declare both)
+        reads = []
+        if wants_resp:
+            for k in range(j, min(n, j + 40)):
+                ln = lines[k]
+                if re.search(r"\bWriter\s+w\s*;", ln) or \
+                        _CALL_RE.search(ln):
+                    break
+                reads.extend(_fields(ln))
+        op.client_sites.append({"path": path, "line": i,
+                                "writes": writes, "reads": reads,
+                                "wants_resp": wants_resp})
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the ctypes boundary
+# ---------------------------------------------------------------------------
+
+def _extern_c_regions(src):
+    """[(start, end)] char offsets inside ``extern "C" { ... }`` blocks
+    (brace-counted), plus single-definition ``extern "C" ret name(...)``
+    forms (handled by the caller's regex already matching them)."""
+    regions = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', src):
+        depth = 1
+        i = m.end()
+        while i < len(src) and depth:
+            c = src[i]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+            i += 1
+        regions.append((m.end(), i))
+    return regions
+
+
+def _parse_c_functions(spec, path, src):
+    regions = _extern_c_regions(src)
+    for m in _CFN_RE.finditer(src):
+        ret, name, args, tail = m.groups()
+        if tail == ";":                 # declaration, not definition
+            continue
+        # only the extern "C" ABI: inside an extern block, or a
+        # single-definition `extern "C" ret name(...)` form
+        in_extern = any(a <= m.start() < b for a, b in regions) or \
+            'extern "C"' in m.group(0)
+        if not in_extern:
+            continue
+        params = []
+        ok = True
+        for raw in args.split(","):
+            raw = " ".join(raw.split())
+            if not raw:
+                continue
+            # drop the parameter name (last identifier)
+            mm = re.match(r"(.+?)\s*(\w+)$", raw)
+            t = (mm.group(1) if mm else raw).replace(" *", "*").strip()
+            tok = _CTYPE_OF.get(t)
+            if tok is None:
+                ok = False
+                break
+            params.append(tok)
+        if not ok:
+            continue
+        lineno = src.count("\n", 0, m.start()) + 1
+        spec.c_functions[name] = {"path": path, "line": lineno,
+                                  "params": params, "ret": ret}
+
+
+class _BindWalk(ast.NodeVisitor):
+    """lib.NAME.argtypes/restype assignments + local ctypes aliases."""
+
+    def __init__(self, spec, path):
+        self.spec = spec
+        self.path = path
+        self.aliases = {}       # local name -> ctype token
+
+    def _tok(self, node):
+        if isinstance(node, ast.Attribute):        # ctypes.c_int64
+            return node.attr
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Call):             # ctypes.POINTER(X)
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, "id", None)
+            if fname == "POINTER" and node.args:
+                return "ptr:" + (self._tok(node.args[0]) or "?")
+        return None
+
+    def visit_Assign(self, node):
+        if len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tok = self._tok(node.value)
+            if tok:
+                self.aliases[node.targets[0].id] = tok
+        t = node.targets[0]
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Attribute) and \
+                isinstance(t.value.value, ast.Name) and \
+                t.value.value.id == "lib":
+            fn = t.value.attr
+            b = self.spec.bindings.setdefault(
+                fn, {"path": self.path, "line": node.lineno,
+                     "argtypes": None, "restype": None})
+            if t.attr == "argtypes" and \
+                    isinstance(node.value, ast.List):
+                b["argtypes"] = [self._tok(e) or "?"
+                                 for e in node.value.elts]
+                b["line"] = node.lineno
+            elif t.attr == "restype":
+                b["restype"] = self._tok(node.value)
+        self.generic_visit(node)
+
+
+class _LibCallWalk(ast.NodeVisitor):
+    """self.lib.NAME(...) call sites (ps/client.py, cstable.py)."""
+
+    def __init__(self, spec, path):
+        self.spec = spec
+        self.path = path
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute) and \
+                f.value.attr == "lib":
+            self.spec.py_calls.append(
+                {"path": self.path, "line": node.lineno,
+                 "name": f.attr, "nargs": len(node.args)})
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+_cache = {}
+
+
+def parse_wire(native_dir=None, py_dir=None, use_cache=True):
+    """Parse the full wire contract; cached per directory pair (the
+    sources only change when a developer edits them mid-session)."""
+    native_dir = native_dir or NATIVE_DIR
+    py_dir = py_dir or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    key = (native_dir, py_dir)
+    if use_cache and key in _cache:
+        return _cache[key]
+    spec = WireSpec(native_dir)
+
+    common = os.path.join(native_dir, "ps_common.h")
+    server = os.path.join(native_dir, "ps_server.cc")
+    client = os.path.join(native_dir, "ps_client.cc")
+    cache = os.path.join(native_dir, "ps_cache.cc")
+    texts = {p: _read(p) for p in (common, server, client, cache)}
+    for p, src in texts.items():
+        spec.sources[p] = src.splitlines()
+
+    _parse_enum(spec, common)
+    _parse_server(spec, server)
+    _parse_client(spec, client)
+    for p in (client, cache, server):
+        _parse_c_functions(spec, p, texts[p])
+
+    native_lib = os.path.join(py_dir, "ps", "native_lib.py")
+    cstable = os.path.join(py_dir, "cstable.py")
+    ps_client_py = os.path.join(py_dir, "ps", "client.py")
+    trees = {}
+    for p in (native_lib, cstable, ps_client_py):
+        if os.path.exists(p):
+            src = _read(p)
+            spec.sources[p] = src.splitlines()
+            trees[p] = ast.parse(src, filename=p)
+    for p in (native_lib, cstable):
+        if p in trees:
+            _BindWalk(spec, p).visit(trees[p])
+    for p in (ps_client_py, cstable):
+        if p in trees:
+            _LibCallWalk(spec, p).visit(trees[p])
+    if use_cache:
+        _cache[key] = spec
+    return spec
+
+
+def _add(spec, report, code, sev, msg, sites, **data):
+    """Emit unless any involved (path, line) carries an ht-ok waiver."""
+    for path, line in sites:
+        lines = spec.sources.get(path)
+        if lines and suppressed(lines, line, code, markers=("ht-ok",)):
+            return None
+    path, line = sites[0]
+    return report.add(code, sev, msg,
+                      where=f"{os.path.relpath(path)}:{line}", **data)
+
+
+def wire_pass(report, native_dir=None, py_dir=None, spec=None):
+    """HT701/HT702 over the parsed contract; returns the spec (the
+    model checker's input)."""
+    spec = spec or parse_wire(native_dir, py_dir)
+    common = os.path.join(spec.native_dir, "ps_common.h")
+
+    for op in spec.ops.values():
+        enum_site = (common, op.enum_line)
+        if op.client_sites and not op.server_cases:
+            _add(spec, report, "HT701", "error",
+                 f"client encodes Op::k{op.name} "
+                 f"(ps_client.cc:{op.client_sites[0]['line']}) but the "
+                 f"server switch has no case for it — every send burns "
+                 f"the full retry budget against status -100",
+                 [enum_site] + [(s["path"], s["line"])
+                                for s in op.client_sites], op=op.name)
+        elif not op.client_sites and op.server_cases:
+            _add(spec, report, "HT701", "warn",
+                 f"Op::k{op.name} has a server handler "
+                 f"(ps_server.cc:{op.server_cases[0][1]}) but no client "
+                 f"encoder — dead handler, or the encoder moved without "
+                 f"its enum entry",
+                 [enum_site, op.server_cases[0]], op=op.name)
+        elif not op.client_sites and not op.server_cases:
+            _add(spec, report, "HT701", "warn",
+                 f"Op::k{op.name} is declared but neither encoded nor "
+                 f"handled — dead wire surface", [enum_site], op=op.name)
+
+        for site in op.client_sites:
+            if not op.server_cases:
+                continue
+            if site["writes"] != op.server_reads:
+                _add(spec, report, "HT702", "error",
+                     f"Op::k{op.name} request schema drift: client "
+                     f"writes [{', '.join(site['writes'])}] "
+                     f"(ps_client.cc:{site['line']}) but the server "
+                     f"reads [{', '.join(op.server_reads)}] "
+                     f"(ps_server.cc:{op.server_cases[0][1]}) — the "
+                     f"handler decodes garbage with status 0",
+                     [(site["path"], site["line"]), op.server_cases[0]],
+                     op=op.name, client=site["writes"],
+                     server=op.server_reads)
+            if site["wants_resp"] and \
+                    site["reads"] != op.server_writes:
+                _add(spec, report, "HT702", "error",
+                     f"Op::k{op.name} response schema drift: server "
+                     f"writes [{', '.join(op.server_writes)}] "
+                     f"(ps_server.cc:{op.server_cases[0][1]}) but the "
+                     f"client decodes [{', '.join(site['reads'])}] "
+                     f"(ps_client.cc:{site['line']})",
+                     [(site["path"], site["line"]), op.server_cases[0]],
+                     op=op.name, client=site["reads"],
+                     server=op.server_writes)
+            if not site["wants_resp"] and op.server_writes and \
+                    not op.accumulating:
+                # async fire-and-forget pushes legitimately drop their
+                # (empty) ack; a non-push op ignoring a real payload is
+                # drift on the client side
+                _add(spec, report, "HT702", "error",
+                     f"Op::k{op.name}: server answers "
+                     f"[{', '.join(op.server_writes)}] but the client "
+                     f"never reads the response",
+                     [(site["path"], site["line"]), op.server_cases[0]],
+                     op=op.name)
+
+    # -- ctypes boundary -------------------------------------------------
+    for name, b in sorted(spec.bindings.items()):
+        if b["argtypes"] is None:
+            continue
+        c = spec.c_functions.get(name)
+        if c is None:
+            _add(spec, report, "HT701", "error",
+                 f"ctypes binds {name} but no extern \"C\" definition "
+                 f"exists in the native sources — CDLL lookup raises at "
+                 f"first use", [(b["path"], b["line"])], symbol=name)
+            continue
+        if b["argtypes"] != c["params"]:
+            _add(spec, report, "HT702", "error",
+                 f"ctypes prototype drift for {name}: python declares "
+                 f"({', '.join(b['argtypes'])}) but C defines "
+                 f"({', '.join(c['params'])}) at "
+                 f"{os.path.basename(c['path'])}:{c['line']} — pointers "
+                 f"reinterpret silently",
+                 [(b["path"], b["line"]), (c["path"], c["line"])],
+                 symbol=name)
+        want_ret = {"void": None, "int": "c_int", "int64_t": "c_int64",
+                    "uint64_t": "c_uint64"}.get(c["ret"], None)
+        if b["restype"] is not None and want_ret is not None and \
+                b["restype"] != want_ret:
+            _add(spec, report, "HT702", "error",
+                 f"ctypes restype drift for {name}: python declares "
+                 f"{b['restype']} but C returns {c['ret']}",
+                 [(b["path"], b["line"]), (c["path"], c["line"])],
+                 symbol=name)
+    for name, c in sorted(spec.c_functions.items()):
+        if name not in spec.bindings:
+            _add(spec, report, "HT701", "warn",
+                 f"extern \"C\" {name} is exported by the native "
+                 f"library but never ctypes-bound — dead ABI surface "
+                 f"(or a binding the bridge forgot)",
+                 [(c["path"], c["line"])], symbol=name)
+
+    # -- python call sites vs prototypes ---------------------------------
+    for call in spec.py_calls:
+        b = spec.bindings.get(call["name"])
+        if b is None or b["argtypes"] is None:
+            continue
+        if call["nargs"] != len(b["argtypes"]):
+            _add(spec, report, "HT702", "error",
+                 f"{call['name']} called with {call['nargs']} args at "
+                 f"{os.path.basename(call['path'])}:{call['line']} but "
+                 f"the prototype declares {len(b['argtypes'])}",
+                 [(call["path"], call["line"]),
+                  (b["path"], b["line"])], symbol=call["name"])
+    return spec
+
+
+def rpc_contract(spec=None):
+    """{python RPC kind: {op, response, blocking}} — the black-box
+    analyzer's lookup for pending flight-ring PS events (what was that
+    RPC on the wire, and what response was the thread waiting for?)."""
+    try:
+        spec = spec or parse_wire()
+    except OSError:
+        return {}
+    out = {}
+    for kind, (opname, blocking) in RPC_KIND_OPS.items():
+        op = spec.op(opname)
+        if op is None:
+            continue
+        resp = ", ".join(op.server_writes) if op.server_writes \
+            else "empty ack"
+        out[kind] = {"op": f"k{opname}", "response": resp,
+                     "blocking": blocking}
+    return out

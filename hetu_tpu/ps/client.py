@@ -60,6 +60,12 @@ class PSClient:
             hosts.encode(), str(ports).encode(), rank, nworkers)
         self.rank = rank
         self.nworkers = nworkers
+        # post-mortem breadcrumb: with the fleet size on the flight
+        # dump, blackbox can map a pending RPC's tensor id to the
+        # server shard it was waiting on (tid % nservers)
+        tel = _telemetry.get_telemetry()
+        if tel.enabled and tel.flight is not None:
+            tel.flight.meta["ps_nservers"] = int(self.nservers)
         # fail fast on a dead server (async paths would otherwise drop
         # requests silently)
         import socket

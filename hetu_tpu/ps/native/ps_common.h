@@ -31,7 +31,10 @@ enum class Op : uint32_t {
   kBarrier = 12,
   kSyncEmbedding = 13,     // bounded-staleness cache pull
   kPushEmbedding = 14,     // cache grad push (bumps versions)
-  kPushSyncEmbedding = 15, // combined push + stale-row pull
+  // combined push + stale-row pull: the cache issues PushEmbedding +
+  // SyncEmbedding as two RPCs today; ROADMAP item 2's sharded fan-out
+  // is speced to fold them into this one round trip per shard
+  kPushSyncEmbedding = 15, // ht-ok: HT701 reserved for item 2 fan-out
   kGetLoads = 16,
   kShutdown = 17,
   kPushData = 18,          // generic blob store (GNN graph shards)

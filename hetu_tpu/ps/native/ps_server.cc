@@ -257,19 +257,32 @@ class Server {
  public:
   Server(int port, int nworkers) : port_(port), nworkers_(nworkers) {}
 
-  int run() {
-    int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
-    int one = 1;
-    ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
-    addr.sin_port = htons(static_cast<uint16_t>(port_));
-    if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
-      std::perror("hetu-ps bind");
+  int run() { return run_fd(-1); }
+
+  // ``lfd >= 0``: an already-bound, already-listening socket inherited
+  // from the launcher — the atomic port claim of ensure_server
+  // (ps/server.py): whoever bind+listens it owns the port, so two
+  // racing spawners can never both start a server. The re-listen below
+  // is a harmless backlog update on that path.
+  int run_fd(int lfd) {
+    if (lfd < 0) {
+      lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+      int one = 1;
+      ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_addr.s_addr = htonl(INADDR_ANY);
+      addr.sin_port = htons(static_cast<uint16_t>(port_));
+      if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr),
+                 sizeof addr) != 0) {
+        std::perror("hetu-ps bind");
+        return 1;
+      }
+    }
+    if (::listen(lfd, 64) != 0) {
+      std::perror("hetu-ps listen");
       return 1;
     }
-    ::listen(lfd, 64);
     std::fprintf(stderr, "[hetu-ps] serving on :%d (%d workers)\n", port_,
                  nworkers_);
     while (!stop_.load()) {
@@ -707,4 +720,12 @@ class Server {
 extern "C" int hetu_ps_run_server(int port, int nworkers) {
   hetups::Server s(port, nworkers);
   return s.run();
+}
+
+// launcher-claimed-socket form: serve on an inherited bound fd (the
+// ensure_server startup-race fix); ``port`` is still needed for the
+// shutdown self-connect poke.
+extern "C" int hetu_ps_run_server_fd(int lfd, int port, int nworkers) {
+  hetups::Server s(port, nworkers);
+  return s.run_fd(lfd);
 }

@@ -43,6 +43,10 @@ def get_lib():
     lib.PSInit.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
                            ctypes.c_int]
     lib.PSInit.restype = ctypes.c_int
+    lib.PSRank.argtypes = []
+    lib.PSRank.restype = ctypes.c_int
+    lib.PSNumWorkers.argtypes = []
+    lib.PSNumWorkers.restype = ctypes.c_int
     lib.PSFinalize.argtypes = []
     lib.InitTensor.argtypes = [ctypes.c_int, ctypes.c_int, i64, i64,
                                ctypes.c_int, ctypes.c_double,
@@ -81,6 +85,9 @@ def get_lib():
     lib.ShutdownServers.argtypes = []
     lib.hetu_ps_run_server.argtypes = [ctypes.c_int, ctypes.c_int]
     lib.hetu_ps_run_server.restype = ctypes.c_int
+    lib.hetu_ps_run_server_fd.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+    lib.hetu_ps_run_server_fd.restype = ctypes.c_int
 
     _lib = lib
     return lib

@@ -70,7 +70,28 @@ def main():
         # PS request loop starts anyway
         print(f"[hetu-ps] telemetry scrape disabled: {e}",
               file=sys.stderr)
-    sys.exit(get_lib().hetu_ps_run_server(port, nworkers))
+    # HETU_PS_LISTEN_FD: an already-bound socket inherited from
+    # ensure_server's atomic port claim (startup-race fix) — serve on
+    # it instead of binding a fresh one
+    lfd = int(os.environ.get("HETU_PS_LISTEN_FD", "-1"))
+    lib = get_lib()          # lazy native build: the slow failure mode
+    # readiness signal: with the port pre-listened by the parent,
+    # connectability no longer means "serving" — write one byte on the
+    # inherited pipe once imports + the native build survived, i.e.
+    # the accept loop is about to run. A child that dies earlier
+    # EOFs the pipe instead, which ensure_server turns into the
+    # "exited during startup" error (it would otherwise see the open
+    # port and return a dead Popen as a live server).
+    ready = int(os.environ.get("HETU_PS_READY_FD", "-1"))
+    if ready >= 0:
+        try:
+            os.write(ready, b"1")
+            os.close(ready)
+        except OSError:
+            pass
+    if lfd >= 0:
+        sys.exit(lib.hetu_ps_run_server_fd(lfd, port, nworkers))
+    sys.exit(lib.hetu_ps_run_server(port, nworkers))
 
 
 if __name__ == "__main__":

@@ -15,7 +15,12 @@ rerun:
   that another never reached names who entered a collective the others
   didn't;
 * **pending operations** — events enqueued but never completed (a
-  ``p2p_recv`` stuck waiting on a peer names that peer);
+  ``p2p_recv`` stuck waiting on a peer names that peer); pending PS
+  RPCs are cross-referenced against the wire contract
+  (``analysis/wire.py``): the verdict names the op on the wire, the
+  response framing the thread was blocked decoding, the server shard
+  the tensor id maps to, and whether that server was among the dead
+  ranks;
 * **last completed step per rank** — the MegaScale-style straggler
   view;
 * **training health** — when the run's health monitor left
@@ -49,6 +54,40 @@ def _load_json(path):
 def _rank_of(path, prefix):
     m = re.search(rf"{prefix}_rank(\d+)\.json$", path)
     return int(m.group(1)) if m else None
+
+
+def _wire_annotate(pending, meta, dead_ranks):
+    """Cross-reference pending PS RPCs against the wire contract
+    (analysis/wire.py): name the op on the wire, the response framing
+    the thread is blocked decoding, the server shard the tensor id maps
+    to (``tid % nservers``, single-part placement), and whether that
+    server index is among the dead ranks of the verdict (co-scheduled
+    server/worker fleets — the common ``heturun`` layout — number the
+    server process with the rank it rode along with)."""
+    try:
+        from ..analysis.wire import rpc_contract
+        contract = rpc_contract()
+    except Exception:           # noqa: BLE001 — augmentation only
+        contract = {}
+    if not contract:
+        return
+    nservers = int(meta.get("ps_nservers", 0) or 0)
+    for ev in pending:
+        if ev.get("group") != "ps":
+            continue
+        c = contract.get(ev.get("kind"))
+        if c is None:
+            continue
+        info = {"op": c["op"], "response": c["response"],
+                "blocking": c["blocking"]}
+        m = re.match(r"tid(\d+)$", str(ev.get("tag") or ""))
+        if m and nservers:
+            server = int(m.group(1)) % nservers
+            info["server"] = server
+            info["nservers"] = nservers
+            if server in dead_ranks:
+                info["server_dead"] = True
+        ev["wire"] = info
 
 
 def analyze(tdir):
@@ -102,11 +141,16 @@ def analyze(tdir):
             "last_step": last_step,
             "last_seq": last_seq,
             "pending": pending,
+            "meta": (dump.get("meta") or {}) if dump else {},
         }
 
     # -- dead ranks: expected but dumped nothing -------------------------
     dead = [r for r, info in ranks.items()
             if not info["flight_dump"] and not info["heartbeat_done"]]
+
+    # -- wire-contract view of pending PS RPCs ---------------------------
+    for info in ranks.values():
+        _wire_annotate(info["pending"], info["meta"], set(dead))
 
     # -- first collective seq divergence ---------------------------------
     divergence = None
@@ -186,10 +230,24 @@ def format_report(rep):
         for ev in info["pending"][:5]:
             where = ev.get("tag") or ev.get("kind")
             peer = ev.get("peer")
-            lines.append(
-                f"    PENDING {ev.get('kind')} seq={ev.get('seq')} "
-                f"tag={where!r}"
-                + (f" waiting on rank {peer}" if peer is not None else ""))
+            line = (f"    PENDING {ev.get('kind')} seq={ev.get('seq')} "
+                    f"tag={where!r}"
+                    + (f" waiting on rank {peer}"
+                       if peer is not None else ""))
+            wire = ev.get("wire")
+            if wire:
+                bits = [wire["op"]]
+                if wire.get("server") is not None:
+                    bits.append(f"server {wire['server']}/"
+                                f"{wire['nservers']}")
+                bits.append("awaiting " + wire["response"] + " response"
+                            if wire["blocking"]
+                            else "fire-and-forget (" + wire["response"]
+                            + ")")
+                if wire.get("server_dead"):
+                    bits.append("SERVER AMONG DEAD RANKS")
+                line += "  [" + "; ".join(bits) + "]"
+            lines.append(line)
     if rep["divergence"]:
         d = rep["divergence"]
         ev = d.get("event") or {}

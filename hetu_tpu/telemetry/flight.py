@@ -58,6 +58,10 @@ class FlightRecorder:
         self._steps_idx = itertools.count()
         self._last_step = -1
         self._reason = None     # first non-routine dump reason sticks
+        # process facts recorders stamp for the post-mortem analyzer
+        # (e.g. ps/client.py records ps_nservers so blackbox can name
+        # which server a pending RPC's tensor lives on)
+        self.meta = {}
 
     # -- recording -------------------------------------------------------
     def start(self, group, kind, peer=None, tag=None, nbytes=0):
@@ -104,6 +108,7 @@ class FlightRecorder:
                 "nprocs": int(os.environ.get("HETU_NUM_PROCS", "1")),
                 "wall": time.time(),
                 "last_step": self._last_step,
+                "meta": dict(self.meta),
                 "steps": [list(s) for s in steps],
                 "events": events}
 

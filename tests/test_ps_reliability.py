@@ -133,3 +133,53 @@ def test_kill_restart_mid_train_completes(ps1):
         client.wait(4102)
         vals = out.copy()
     np.testing.assert_allclose(vals, -0.5 * np.ones(16), rtol=1e-5)
+
+
+def test_ensure_server_adopts_startup_race_winner(monkeypatch):
+    """Two processes race ensure_server: both see the port closed, both
+    try to claim it — the kernel lets exactly one bind. The loser must
+    wait for the winner's server and adopt it (return None), not spawn
+    a doomed child or raise (ISSUE 13 satellite). Simulated by
+    occupying the port with a listener while forcing the fast-path
+    check to miss it once (the race window)."""
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("0.0.0.0", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+    real_port_open = ps_server._port_open
+    calls = {"n": 0}
+
+    def racy_port_open(host, p):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return False        # the race window: check misses the winner
+        return real_port_open(host, p)
+
+    monkeypatch.setattr(ps_server, "_port_open", racy_port_open)
+    procs_before = list(ps_server._server_procs)
+    try:
+        # the claim-bind fails (winner holds the port): adopt, never
+        # spawn — and never hand back a dead Popen
+        assert ps_server.ensure_server(port=port, nworkers=1) is None
+        assert ps_server._server_procs == procs_before
+        assert calls["n"] >= 2          # fast path missed, adopt re-checked
+    finally:
+        sock.close()
+
+
+def test_ensure_server_detects_child_death_during_startup(monkeypatch):
+    """With the port pre-listened by the parent's claim, connectability
+    no longer proves the child is serving — a child that dies during
+    startup must surface as "exited during startup" via the readiness
+    pipe, not be handed back as a live server whose backlog swallows
+    connections."""
+    monkeypatch.setattr(ps_server.sys, "executable", "/bin/false")
+    port = ps_server.pick_free_port()
+    try:
+        with pytest.raises(RuntimeError, match="during startup"):
+            ps_server.ensure_server(port=port, nworkers=1, wait_s=5.0)
+        # the claim died with the child: the port is free again
+        assert not ps_server._port_open("127.0.0.1", port)
+    finally:
+        ps_server.shutdown_server()

@@ -1,0 +1,171 @@
+"""Wire-framing round-trip tests (ISSUE 13 satellite): every wire op in
+``ps/client.py`` driven against a live server with edge shapes — empty
+index vectors, width-1 rows, a max-range tensor id, duplicate ids in
+one sparse_push. These pin the on-the-wire behavior the static
+wire-contract checker (``analysis/wire.py``) models: if the framing
+idiom in the native sources drifts from what the parser extracts, the
+parser test (``test_protocol.py::test_wire_parse_matches_reality``)
+breaks; if the framing drifts from what the server actually does,
+these break.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import server as ps_server
+from hetu_tpu.ps import client as ps_client
+from hetu_tpu.analysis import wire
+
+
+@pytest.fixture(scope="module")
+def ps():
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_PORTS"] = str(port)
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    yield client
+    client.shutdown_servers()
+    client.close()
+    ps_server.shutdown_server()
+
+
+def test_every_python_rpc_kind_has_a_wire_op(ps):
+    """The RPC kinds the client's flight recorder emits all resolve
+    through the parsed contract — blackbox's pending-RPC annotation can
+    never meet an unknown kind."""
+    contract = wire.rpc_contract()
+    spec = wire.parse_wire()
+    for kind, info in contract.items():
+        assert info["op"].startswith("k")
+        assert spec.op(info["op"][1:]) is not None, kind
+
+
+def test_empty_indices_roundtrip(ps):
+    """Zero-length index vectors: the client must frame (or skip) them
+    without tripping the server, and outputs keep the (0, width)
+    shape."""
+    ps.init_tensor(7001, (8, 3), kind=2, opt="None")
+    ps.set_param(7001, np.zeros((8, 3), np.float32))
+    empty = np.empty(0, np.int64)
+
+    out = ps.sparse_pull(7001, empty, width=3)
+    assert out.shape == (0, 3)
+    ps.sparse_push(7001, empty, np.empty((0, 3), np.float32), width=3)
+    ps.wait(7001)
+    ps.push_embedding(7001, empty, np.empty((0, 3), np.float32),
+                      np.empty(0, np.int64), width=3)
+    ps.wait(7001)
+    versions = np.empty(0, np.int64)
+    rows = np.empty((0, 3), np.float32)
+    assert ps.sync_embedding(7001, 0, empty, versions, rows, 3) == 0
+    # the table is untouched by the empty ops
+    np.testing.assert_allclose(ps.pull(7001, (8, 3)),
+                               np.zeros((8, 3)))
+
+
+def test_width1_rows_roundtrip(ps):
+    """Width-1 tables (a 1-D embedding / per-id bias) exercise the
+    degenerate row stride on every sparse op."""
+    ps.init_tensor(7002, (10, 1), kind=2, opt="None")
+    ps.set_param(7002, np.arange(10, dtype=np.float32).reshape(10, 1))
+    idx = np.array([0, 9, 4])
+    got = ps.sparse_pull(7002, idx, width=1)
+    np.testing.assert_allclose(got.ravel(), [0, 9, 4])
+
+    ps.sparse_push(7002, np.array([4]),
+                   np.full((1, 1), 0.5, np.float32), width=1)
+    ps.wait(7002)
+    np.testing.assert_allclose(
+        ps.sparse_pull(7002, np.array([4]), width=1).ravel(), [4.5])
+
+    # bounded-staleness protocol at width 1
+    versions = np.zeros(3, np.int64)
+    rows = np.zeros((3, 1), np.float32)
+    n = ps.sync_embedding(7002, 0, idx, versions, rows, 1)
+    assert n == 1                       # only row 4 ever advanced
+    np.testing.assert_allclose(rows[2], [4.5])
+    np.testing.assert_allclose(versions, [0, 0, 1])
+
+    out = ps.ss_pushpull(7002, np.array([0]),
+                         np.full((1, 1), 2.0, np.float32),
+                         np.array([0, 1]), width=1)
+    ps.wait(7002)
+    np.testing.assert_allclose(out.ravel(), [2, 1])
+
+
+def test_max_tid_roundtrip(ps):
+    """Tensor ids are int32 on the wire (MsgHeader.tensor_id); the
+    maximum id must survive framing, dedup and storage."""
+    tid = 2**31 - 1
+    ps.init_tensor(tid, (4, 2), kind=1, opt="None")
+    ps.set_param(tid, np.ones((4, 2), np.float32))
+    np.testing.assert_allclose(ps.pull(tid, (4, 2)),
+                               np.ones((4, 2)))
+    ps.sparse_push(tid, np.array([3]), 2 * np.ones((1, 2), np.float32),
+                   width=2)
+    ps.wait(tid)
+    np.testing.assert_allclose(
+        ps.sparse_pull(tid, np.array([3]), width=2).ravel(), [3, 3])
+
+
+def test_duplicate_ids_one_sparse_push_version_accounting(ps):
+    """Duplicate ids inside ONE sparse_push must aggregate exactly once
+    per row AND advance the row version by the occurrence count — the
+    version algebra the bounded-staleness cache protocol depends on."""
+    ps.init_tensor(7003, (6, 2), kind=2, opt="None")
+    ps.set_param(7003, np.zeros((6, 2), np.float32))
+    idx = np.array([2, 2, 2, 5], dtype=np.int64)
+    vals = np.ones((4, 2), np.float32)
+    ps.sparse_push(7003, idx, vals, width=2)
+    ps.wait(7003)
+    got = ps.sparse_pull(7003, np.array([2, 5]), width=2)
+    np.testing.assert_allclose(got[0], [3, 3])       # summed once
+    np.testing.assert_allclose(got[1], [1, 1])
+    # versions advanced by occurrence count: bound=2 tolerates row 5
+    # (1 update) but row 2 (3 updates) must refresh
+    versions = np.zeros(2, np.int64)
+    rows = np.zeros((2, 2), np.float32)
+    n = ps.sync_embedding(7003, 2, np.array([2, 5]), versions, rows, 2)
+    assert n == 1
+    np.testing.assert_allclose(versions, [3, 0])
+
+
+def test_remaining_wire_ops_roundtrip(ps, tmp_path):
+    """One sweep over every remaining client-encoded op, so each wire
+    op in ps/client.py is driven at least once by this module: dense
+    push/pull, dd_pushpull, sd_pushpull, data blobs, save/load, clear,
+    loads, barrier, wait_all."""
+    ps.init_tensor(7004, (5,), kind=0, opt="SGD", lrs=[1.0])
+    ps.set_param(7004, np.zeros(5, np.float32))
+    ps.push(7004, np.ones(5, np.float32))          # kDensePush
+    ps.wait(7004)
+    np.testing.assert_allclose(ps.pull(7004, (5,)),     # kDensePull
+                               -np.ones(5))
+    out = ps.dd_pushpull(7004, np.ones(5, np.float32))  # kDDPushPull
+    ps.wait(7004)
+    np.testing.assert_allclose(out, -2 * np.ones(5))
+
+    ps.init_tensor(7005, (4, 2), kind=1, opt="None")
+    ps.set_param(7005, np.zeros((4, 2), np.float32))
+    full = ps.sd_pushpull(7005, np.array([1]),           # kSDPushPull
+                          np.ones((1, 2), np.float32), width=2,
+                          out_len=8)
+    ps.wait(7005)
+    np.testing.assert_allclose(full.reshape(4, 2)[1], [1, 1])
+
+    path = str(tmp_path / "t7005.bin")
+    assert ps.save_param(7005, path) == 0           # kParamSave
+    assert ps.clear(7005) == 0                      # kParamClear
+    assert ps.pull(7005, (4, 2)).std() == 0
+    assert ps.load_param(7005, path) == 0           # kParamLoad
+    np.testing.assert_allclose(ps.pull(7005, (4, 2)).reshape(4, 2)[1],
+                               [1, 1])
+
+    ps.push_data(77, np.arange(3, dtype=np.float32))    # kPushData
+    np.testing.assert_allclose(ps.pull_data(77, 3),     # kPullData
+                               np.arange(3))
+    assert ps.get_loads() > 0                       # kGetLoads
+    ps.barrier()                                    # kBarrier (1 worker)
+    ps.wait_all()                                   # local drain
