@@ -3,7 +3,7 @@ sessions.
 
 The define-then-run model hands us the *whole* program — graph,
 partition states, pipeline schedule, placement — before a single byte
-moves. This package runs four static passes over the topo-sorted graph
+moves. This package runs six static passes over the topo-sorted graph
 between construction and first dispatch, each emitting structured
 :class:`~.findings.Finding` objects with stable codes and per-op user
 provenance:
@@ -22,7 +22,15 @@ provenance:
 5. **overlap** (HT5xx, advisory) — feed-bound (PS-backed) configs that
    run with the async ingest engine off, or through plain per-step
    ``run()`` loops that never engage it (runtime half in
-   ``executor.py``).
+   ``executor.py``),
+6. **numerics** (HT8xx) — interval + dtype abstract interpretation:
+   per-node value intervals seeded from initializer distributions and
+   op semantics, precision classes riding the dtype propagation;
+   overflow-prone low-precision ops, unguarded div/log/rsqrt domains,
+   integer-exactness cliffs on the id paths, low-precision
+   accumulation/boundary/underflow risks, PRNG stream reuse — with
+   ``analysis/rangecheck.py`` as its measured-range dynamic twin
+   (soundness gate + persistent range DB that tightens re-analysis).
 
 Two codebase self-lints ride beside the graph passes: **jit_purity**
 (HTPxx — host impurity inside jit-traced bodies) and **concurrency**
@@ -54,6 +62,7 @@ from .sharding import sharding_pass
 from .deadlock import deadlock_pass
 from .memory import memory_pass, check_compiled
 from .overlap import overlap_pass, RunLoopAdvisor
+from .numerics import numerics_pass
 from .findings import suppressed
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
@@ -61,7 +70,7 @@ __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
            "finish_preflight",
            "shape_pass", "lint_pass", "frozen_graph_pass",
            "sharding_pass", "deadlock_pass", "memory_pass",
-           "overlap_pass", "RunLoopAdvisor",
+           "overlap_pass", "numerics_pass", "RunLoopAdvisor",
            "check_compiled", "EXIT_PREFLIGHT"]
 
 # distinct exit code for "preflight found errors" (cf. the watchdog's
@@ -114,11 +123,14 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
                        f"incomplete")
             return None
 
+    dtypes = {}
     shapes = _guard("shapes", shape_pass, topo, report,
-                    feed_shapes=feed_shapes) or {}
+                    feed_shapes=feed_shapes, dtypes_out=dtypes) or {}
     _guard("lint", lint_pass, topo, report,
            eval_nodes=eval_node_list, extra_roots=extra_roots)
     _guard("sharding", sharding_pass, topo, report, shapes=shapes)
+    _guard("numerics", numerics_pass, topo, report, shapes=shapes,
+           dtypes=dtypes, feed_shapes=feed_shapes, config=config)
     _guard("deadlock", deadlock_pass, eval_node_list, report,
            schedule=schedule or "gpipe", nprocs=nprocs,
            num_microbatches=num_microbatches,

@@ -125,6 +125,16 @@ def _main_all(names, args):
     sections["protocol"]["model"] = stats
     gates["protocol"] = 1 if len(proto) else 0
 
+    # numerics & precision verifier (HT8xx): zoo sweep gating on ANY
+    # unsuppressed finding — same semantics as its standalone CLI
+    # (python -m hetu_tpu.analysis.numerics)
+    from .numerics import check_zoo
+    num = check_zoo(names)
+    sections["numerics"] = {n: json.loads(r.to_json())
+                            for n, r in num.items()}
+    num_total = sum(len(r) for r in num.values())
+    gates["numerics"] = 1 if num_total else 0
+
     rc = max(gates.values())
     merged = {"ok": rc == 0, "gates": gates, "sections": sections}
     if args.json:
@@ -136,13 +146,16 @@ def _main_all(names, args):
               + f"; jit-purity {len(jit.errors)} error(s); "
               f"concurrency {len(conc)} finding(s); protocol "
               f"{len(proto)} finding(s), {stats['states']} model "
-              f"states explored")
+              f"states explored; numerics {num_total} finding(s)")
         for name, rep in models.items():
             for f in rep.errors:
                 print(f"   zoo/{name}: {f}")
         for rep in (jit, conc, proto):
             for f in rep.findings:
                 print("   " + str(f))
+        for name, rep in num.items():
+            for f in rep.findings:
+                print(f"   numerics/{name}: {f}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f, indent=2)

@@ -21,7 +21,8 @@ import json
 import re
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
-           "emit", "provenance", "suppressed", "SEVERITIES"]
+           "emit", "provenance", "suppressed", "suppressed_at",
+           "SEVERITIES"]
 
 SEVERITIES = ("error", "warn", "info")
 
@@ -61,6 +62,26 @@ def suppressed(lines, lineno, code=None, markers=SUPPRESS_MARKERS):
             if not codes or code is None or code in codes:
                 return True
     return False
+
+
+_SRC_CACHE = {}
+
+
+def suppressed_at(path, lineno, code=None, markers=SUPPRESS_MARKERS):
+    """:func:`suppressed` over a source FILE, with the read cached per
+    process — the shared file-layer for passes whose findings anchor at
+    a ``defined_at`` construction site rather than an already-parsed
+    source (numerics; the wire/protocol passes keep their own parsed
+    lines)."""
+    lines = _SRC_CACHE.get(path)
+    if lines is None:
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+        _SRC_CACHE[path] = lines
+    return suppressed(lines, lineno, code, markers=markers)
 
 
 def provenance(node):

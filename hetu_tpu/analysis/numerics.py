@@ -1,0 +1,797 @@
+"""Pass 8 — numerics & precision verifier (HT8xx).
+
+Abstract interpretation over the dataflow graph, in the spirit of the
+FPTaylor/Herbie class of floating-point analyses but scoped to what a
+define-then-run training graph can prove cheaply: every node carries
+
+* a **value interval** ``(lo, hi)`` bounding every element of its
+  output, seeded from initializer distributions
+  (``initializers.BaseInit.interval``), constant feeds, and known op
+  semantics (softmax/sigmoid/tanh in their ranges, exp/log/rsqrt
+  monotone, norm outputs bounded by ``sqrt(n)·|scale| + |bias|``,
+  attention outputs inside the value hull, matmul/conv/reductions by
+  ``K·max|A|·max|B|``), propagated through the per-op ``infer_range``
+  protocol (``ops/*.py``) with the shape-aware cases handled centrally
+  here, and
+* a **precision class** (fp32 / bf16 / fp16 / int) riding the HT1xx
+  dtype propagation in ``analysis/shapes.py``.
+
+Unknown feeds propagate as *unknown* (no claim, no false positive) —
+the same philosophy as the shape pass — and the measured-range DB the
+dynamic twin (``analysis/rangecheck.py``) persists tightens them on
+re-analysis.
+
+Error codes
+-----------
+HT801  overflow-prone op in low precision: the derived interval
+       exceeds the dtype's max-representable (un-shifted exp / square
+       in fp16 being the classic)                       error (lp) / warn
+HT802  low-precision accumulation: a reduction/matmul/conv
+       accumulating in bf16/fp16 over N elements whose worst-case
+       error N·eps/2 exceeds the bound — remediation is
+       ``preferred_element_type``/fp32 accumulation      warn
+HT803  integer-exactness loss: float-dtype ids (exact only to
+       2^mantissa — the trillion-row cliff), an id dtype narrower
+       than the declared table, or an int-to-float cast past the
+       target's exact range                             error / warn
+HT804  div/log/sqrt/rsqrt whose operand interval contains zero with
+       no eps/clip guard on the path (interval arithmetic IS the
+       guard detector: ``x*x + eps`` excludes zero, raw softmax
+       output does not); also norm eps <= 0 and optimizer eps <= 0   warn
+HT805  low-precision cross-replica/pipeline boundary: bf16/fp16
+       ppermute or allreduce edges whose derived error bound
+       (hops · eps/2) exceeds the declared tolerance, or an fp16
+       boundary whose halved exponent range was never retuned   error/warn
+HT806  gradient-underflow risk: a backward path entirely in fp16
+       with no loss scale (interval below fp16 min-normal upgrades
+       the severity)                                    warn / error
+HT807  PRNG stream reuse: one key consumed by two independent random
+       ops (correlated dropout masks — silent wrongness)      error
+
+Waivers: ``# ht-ok: HT8xx <reason>`` on the **user construction line**
+a finding's provenance points at (``Op.defined_at``) — the same one
+grep surface as every other pass.
+
+CLI: ``python -m hetu_tpu.analysis.numerics [models...] [--json]``
+sweeps the zoo and exits 1 on ANY unsuppressed finding (the CI
+``analysis`` job's gate, via the ``--all`` aggregate driver).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .findings import suppressed_at
+
+__all__ = ["numerics_pass", "seed_interval", "stable_keys",
+           "boundary_error_bound", "accum_error_bound", "prec_class",
+           "dtype_max", "dtype_eps", "dtype_tiny", "exact_int_limit",
+           "TRAINING_DRIFT", "MEASURED_EXPAND", "ACC_TOL", "main"]
+
+_INF = float("inf")
+
+# trainable parameters drift during training: their initializer seed
+# interval widens to ± max(TRAINING_DRIFT · init_absmax, 1.0) so a
+# rangecheck run a few steps in still lands inside the static interval;
+# the measured-range DB replaces the heuristic after a real run
+TRAINING_DRIFT = 16.0
+
+# a measured (lo, hi) from the range DB is re-seeded widened about its
+# center by this factor — measured ranges are samples, not bounds
+MEASURED_EXPAND = 4.0
+
+# HT802 fires when the worst-case accumulation error N·eps/2 exceeds
+# this relative bound
+ACC_TOL = 0.05
+
+
+# ---------------------------------------------------------------------------
+# dtype tables
+# ---------------------------------------------------------------------------
+
+def _np_dtype(dt):
+    if dt is None:
+        return None                 # np.dtype(None) is float64 — no
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return None
+
+
+def prec_class(dtype):
+    """'fp64' | 'fp32' | 'bf16' | 'fp16' | 'int' | None."""
+    dt = _np_dtype(dtype)
+    if dt is None:
+        return None
+    name = dt.name
+    return {"float64": "fp64", "float32": "fp32", "bfloat16": "bf16",
+            "float16": "fp16"}.get(
+        name, "int" if dt.kind in "iub" else None)
+
+
+def _finfo(dtype):
+    import jax.numpy as jnp
+    return jnp.finfo(dtype)
+
+
+def dtype_max(dtype):
+    """Largest finite value of a float dtype (fp16's 65504 cliff)."""
+    return float(_finfo(dtype).max)
+
+
+def dtype_eps(dtype):
+    """Machine epsilon (bf16: 2^-7 — 8 significand bits total)."""
+    return float(_finfo(dtype).eps)
+
+
+def dtype_tiny(dtype):
+    """Smallest positive normal (fp16: 6.1e-5 — the underflow knee
+    Micikevicius et al.'s loss scaling exists to clear)."""
+    return float(_finfo(dtype).tiny)
+
+
+def exact_int_limit(dtype):
+    """Largest N with every integer in [0, N] exactly representable
+    (float32: 2^24 — the id-through-float exactness cliff)."""
+    return 2 ** (int(_finfo(dtype).nmant) + 1)
+
+
+def accum_error_bound(dtype, n):
+    """Worst-case relative error of summing ``n`` same-sign terms in
+    ``dtype``: n·eps/2 (standard recursive-summation bound)."""
+    return float(n) * dtype_eps(dtype) / 2.0
+
+
+def boundary_error_bound(dtype, hops=1):
+    """Relative error bound for a value crossing ``hops`` low-precision
+    cast boundaries (each round-trip cast contributes eps/2) — the
+    HT805 interval math the bf16 pipeline-boundary tolerance test pins
+    against the runtime's declared rtol."""
+    return float(max(1, hops)) * dtype_eps(dtype) / 2.0
+
+
+# ---------------------------------------------------------------------------
+# interval plumbing
+# ---------------------------------------------------------------------------
+
+def _absmax(rng):
+    return max(abs(rng[0]), abs(rng[1]))
+
+
+def _hull(*rngs):
+    known = [r for r in rngs if r is not None]
+    if len(known) != len(rngs):
+        return None
+    return (min(r[0] for r in known), max(r[1] for r in known))
+
+
+def _intersect(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    return (lo, hi) if lo <= hi else a   # disjoint: trust the transfer
+
+
+def _expand_measured(rng):
+    lo, hi = float(rng[0]), float(rng[1])
+    c = (lo + hi) / 2.0
+    half = max((hi - lo) / 2.0, 1e-6 + 1e-3 * max(abs(lo), abs(hi)))
+    return (c - MEASURED_EXPAND * half, c + MEASURED_EXPAND * half)
+
+
+def stable_keys(topo):
+    """Per-node keys stable across rebuilds of the same graph (node
+    *names* embed the process-global id counter, so they differ between
+    two builds in one process): topo position + op type. The
+    measured-range DB (rangecheck.RangeDB) is keyed on these."""
+    return [f"{i:04d}:{n.op_type}" for i, n in enumerate(topo)]
+
+
+def seed_interval(node, measured=None):
+    """Interval seed for a leaf placeholder: exact min/max for constant
+    values, the initializer's distribution bound (widened by
+    TRAINING_DRIFT for trainables), measured DB entry when present,
+    else unknown."""
+    iv = None
+    value = getattr(node, "tensor_value", None)
+    if value is not None:
+        try:
+            arr = value.asnumpy() if hasattr(value, "asnumpy") \
+                else np.asarray(value)
+            iv = (float(arr.min()), float(arr.max()))
+        except (TypeError, ValueError):
+            iv = None
+    elif getattr(node, "initializer", None) is not None:
+        got = node.initializer.interval()
+        iv = (float(got[0]), float(got[1])) if got is not None else None
+    if iv is not None and getattr(node, "trainable", False):
+        m = max(TRAINING_DRIFT * _absmax(iv), 1.0)
+        iv = (-m, m)
+    if measured is not None:
+        iv = _intersect(iv, _expand_measured(measured))
+    return iv
+
+
+# ---------------------------------------------------------------------------
+# central transfer table: structural + shape-aware ops
+# ---------------------------------------------------------------------------
+
+_PASS_THROUGH = {
+    "ArrayReshapeOp", "ArrayReshapeGradientOp", "TransposeOp",
+    "FlattenOp", "SqueezeOp", "UnsqueezeOp", "BroadcastToOp",
+    "BroadcastShapeOp", "SliceOp", "SplitOp", "SplitGradientOp",
+    "PadGradientOp", "ConcatGradientOp", "ConcatenateGradientOp",
+    "DataH2DOp", "DataD2HOp", "PipelineSendOp",
+    "AllReduceCommunicateOp", "GroupAllReduceCommunicateOp",
+    "ParameterServerCommunicateOp", "EmbeddingLookUpGradient",
+    "DispatchOp",
+}
+
+_CONST_RANGE = {
+    "OnesLikeOp": (1.0, 1.0),
+    "ZerosLikeOp": (0.0, 0.0),
+    "OptimizerOp": (0.0, 0.0),
+}
+
+
+def _matmul_k(node, in_shapes):
+    a = in_shapes[0]
+    if a is None or len(a) < 2:
+        return None
+    if node.op_type == "MatMulOp":
+        return a[0] if node.matmul_attr_trans_A else a[1]
+    return a[-2] if node.trans_A else a[-1]
+
+
+def _transfer(node, in_rngs, in_shapes):
+    """Range for shape-aware / structural ops the per-op protocol
+    doesn't cover. None = unknown."""
+    ot = node.op_type
+    if ot in _CONST_RANGE:
+        return _CONST_RANGE[ot]
+    if ot in _PASS_THROUGH:
+        return in_rngs[0] if in_rngs else None
+    if ot in ("ConcatOp", "ConcatenateOp"):
+        return _hull(*in_rngs)
+    if ot == "PadOp":
+        a = in_rngs[0]
+        if a is None:
+            return None
+        c = float(getattr(node, "constant_values", 0) or 0)
+        return (min(a[0], c), max(a[1], c))
+    if ot == "SliceGradientOp":
+        a = in_rngs[0]
+        return None if a is None else (min(a[0], 0.0), max(a[1], 0.0))
+    if ot in ("MatMulOp", "BatchMatMulOp"):
+        a, b = in_rngs[0], in_rngs[1]
+        k = _matmul_k(node, in_shapes)
+        if a is None or b is None or k is None:
+            return None
+        m = float(k) * _absmax(a) * _absmax(b)
+        if a[0] >= 0 and b[0] >= 0:
+            return (float(k) * a[0] * b[0], m)
+        return (-m, m)
+    if ot == "Conv2dOp":
+        a, w = in_rngs[0], in_rngs[1]
+        f = in_shapes[1]
+        if a is None or w is None or f is None or len(f) != 4:
+            return None
+        k = f[1] * f[2] * f[3]
+        m = float(k) * _absmax(a) * _absmax(w)
+        return (-m, m)
+    if ot in ("ReduceSumOp", "ReduceSumAxisZeroOp"):
+        a = in_rngs[0]
+        s = in_shapes[0]
+        if a is None or s is None:
+            return None
+        if ot == "ReduceSumAxisZeroOp":
+            n = s[0] if s else 1
+        else:
+            n = 1
+            for ax in node.axes:
+                if ax < len(s):
+                    n *= s[ax]
+        return (min(n * a[0], a[0]), max(n * a[1], a[1]))
+    if ot == "ReduceMeanOp":
+        return in_rngs[0]
+    if ot in ("BroadcastShapeGradSourceOp", "UnbroadcastOp"):
+        # sums the adjoint over the broadcast axes; without the exact
+        # fan-in keep only a sign-preserving unknown
+        return None
+    if ot in ("FlashAttentionOp", "RingAttentionOp",
+              "UlyssesAttentionOp"):
+        # softmax rows are convex weights: output lies in v's hull
+        return in_rngs[2] if len(in_rngs) > 2 else None
+    if ot == "PipelineReceiveOp":
+        return None
+    return None
+
+
+# HT804 domain table: op type -> (operand index, predicate, what)
+def _domain_violation(node, in_rngs):
+    ot = node.op_type
+    if ot == "LogOp":
+        a = in_rngs[0]
+        if a is not None and a[0] <= 0.0:
+            return ("log", a, "operand interval reaches <= 0")
+    elif ot == "SqrtOp":
+        a = in_rngs[0]
+        if a is not None and a[0] < 0.0:
+            return ("sqrt", a, "operand interval reaches < 0")
+    elif ot == "ReciprocalSqrtOp":
+        a = in_rngs[0]
+        if a is not None and a[0] <= 0.0:
+            return ("rsqrt", a, "operand interval reaches <= 0")
+    elif ot == "DivOp":
+        b = in_rngs[1]
+        if b is not None and b[0] <= 0.0 <= b[1]:
+            return ("div", b, "denominator interval contains 0")
+    elif ot == "DivConstOp":
+        a = in_rngs[0]
+        if a is not None and a[0] <= 0.0 <= a[1]:
+            return ("div", a, "denominator interval contains 0")
+    elif ot == "PowerOp":
+        a = in_rngs[0]
+        if getattr(node, "p", 1) < 0 and a is not None \
+                and a[0] <= 0.0 <= a[1]:
+            return ("pow", a, "negative power over an interval "
+                              "containing 0")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# source-line waivers on the construction provenance
+# ---------------------------------------------------------------------------
+
+def _suppressed_node(node, code):
+    site = getattr(node, "defined_at", None)
+    if not site:
+        return False
+    return suppressed_at(site[0], site[1], code)
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def _stage_count(topo):
+    """Distinct device contexts across the graph — the collective
+    pipeline's stage count for the HT805 hop bound."""
+    seen = set()
+    for n in topo:
+        ctxs = getattr(getattr(n, "raw_ctx", None), "_contexts", None)
+        if not ctxs:
+            continue
+        for c in ctxs:
+            for cc in (c if isinstance(c, tuple) else (c,)):
+                seen.add((getattr(cc, "hostname", None),
+                          getattr(cc, "device_id", None)))
+    return max(1, len(seen))
+
+
+def _canon_low_prec(spec):
+    """'bfloat16' | 'float16' | None from any spelling the runtime's
+    ``_canon_boundary_dtype`` accepts — strings OR dtype objects
+    (``np.float16``, ``jnp.bfloat16``)."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        name = spec.lower()
+        if name in ("bf16", "bfloat16"):
+            return "bfloat16"
+        if name in ("fp16", "f16", "float16", "half"):
+            return "float16"
+        return None
+    try:
+        name = np.dtype(spec).name
+    except TypeError:
+        return None
+    return name if name in ("bfloat16", "float16") else None
+
+
+def numerics_pass(topo, report, shapes=None, dtypes=None,
+                  feed_shapes=None, config=None, measured=None,
+                  acc_tol=ACC_TOL, boundary_rtol=None):
+    """Run the HT8xx checks over a topo-sorted graph; returns the
+    derived ``{node: (lo, hi) or None}`` interval map.
+
+    ``shapes``/``dtypes`` are the shape pass's outputs (recomputed here
+    when absent); ``measured`` is a ``{stable_key: (lo, hi)}`` map from
+    the rangecheck DB that tightens the seeds; ``config`` (a
+    HetuConfig) enables the mixed-precision flow checks (HT805/HT806).
+    Findings whose construction line carries ``# ht-ok: HT8xx`` are
+    waived."""
+    from ..ops.variable import PlaceholderOp
+    from ..optimizer import OptimizerOp
+
+    if shapes is None or dtypes is None:
+        from .findings import Report
+        from .shapes import shape_pass
+        dtypes = {} if dtypes is None else dtypes
+        shapes = shape_pass(topo, Report(), feed_shapes=feed_shapes,
+                            dtypes_out=dtypes)
+
+    keys = stable_keys(topo)
+    measured = measured or {}
+
+    # Executor(dtype="bfloat16"/"float16") casts every float param and
+    # feed to the compute dtype inside the traced step: the session's
+    # EFFECTIVE precision for float nodes is config.dtype, not the
+    # declared fp32 the graph was built with — without this the
+    # headline low-precision checks (HT801/HT802) are blind on the
+    # repo's own mixed-precision path
+    cfg_dt = _np_dtype(getattr(config, "dtype", None)) \
+        if config is not None else None
+    if prec_class(cfg_dt) not in ("bf16", "fp16"):
+        cfg_dt = None
+
+    def eff_dtype(n):
+        dt = dtypes.get(n)
+        if cfg_dt is not None and dt is not None and dt.kind == "f":
+            return cfg_dt
+        return dt
+
+    def add(code, severity, message, node):
+        if _suppressed_node(node, code):
+            return
+        report.add(code, severity, message, node=node)
+
+    ranges = {}
+    for i, node in enumerate(topo):
+        in_rngs = [ranges.get(x) for x in node.inputs]
+        in_shapes = [shapes.get(x) for x in node.inputs]
+        if isinstance(node, PlaceholderOp):
+            rng = seed_interval(node, measured=measured.get(keys[i]))
+        else:
+            rng = None
+            infer = getattr(node, "infer_range", None)
+            if infer is not None:
+                try:
+                    rng = infer(in_rngs, in_shapes)
+                except Exception:   # noqa: BLE001 — a bad bound is no bound
+                    rng = None
+            if rng is None:
+                rng = _transfer(node, in_rngs, in_shapes)
+            if keys[i] in measured:
+                rng = _intersect(rng, _expand_measured(measured[keys[i]]))
+        if rng is not None and (math.isnan(rng[0]) or math.isnan(rng[1])
+                                or rng[0] > rng[1]):
+            rng = None      # a degenerate bound is no bound — a NaN
+            # interval would compare False everywhere and silently
+            # disarm every downstream check
+        ranges[node] = rng
+
+        dt = eff_dtype(node)
+        prec = prec_class(dt)
+
+        # HT804 — domain hazards (zero-crossing operand, missing guard)
+        hit = _domain_violation(node, in_rngs)
+        if hit is not None:
+            what, iv, why = hit
+            add("HT804", "warn",
+                f"{node.op_type} {node.name}: {why} "
+                f"([{iv[0]:.3g}, {iv[1]:.3g}]) with no eps/clip guard "
+                f"on the path — add a clip/+eps (interval arithmetic "
+                f"recognizes the guard and clears this)", node)
+        eps = getattr(node, "eps", None)
+        if eps is not None and "Normalization" in node.op_type \
+                and "Gradient" not in node.op_type and eps <= 0:
+            add("HT804", "warn",
+                f"{node.op_type} {node.name}: eps={eps} — the rsqrt "
+                f"of the variance is unguarded at zero variance", node)
+        if isinstance(node, OptimizerOp):
+            oeps = getattr(node.optimizer, "epsilon",
+                           getattr(node.optimizer, "eps", None))
+            if oeps is not None and oeps <= 0:
+                add("HT804", "warn",
+                    f"{node.name}: optimizer eps={oeps} — the "
+                    f"sqrt(v)+eps denominator is unguarded", node)
+
+        # HT801 — derived interval exceeds the dtype's representable max
+        if prec in ("fp16", "bf16", "fp32", "fp64") and rng is not None:
+            am = _absmax(rng)
+            fmax = dtype_max(dt)
+            explosive = node.op_type in ("ExpOp", "PowerOp", "MulOp",
+                                         "MatMulOp", "BatchMatMulOp")
+            # only the node that CREATES the overflow fires — an input
+            # already past ITS OWN dtype's max re-reports the same root
+            # cause on every downstream consumer otherwise. Each input
+            # is judged against its own precision: a fp32 interval past
+            # 65504 cast to fp16 is overflow CREATED by the cast, not
+            # propagated through it.
+            def _in_bounds(inp, r):
+                if r is None:
+                    return True
+                idt = eff_dtype(inp)
+                if prec_class(idt) not in ("fp16", "bf16", "fp32",
+                                           "fp64"):
+                    return True
+                return _absmax(r) <= dtype_max(idt)
+            created = all(_in_bounds(inp, r)
+                          for inp, r in zip(node.inputs, in_rngs))
+            if created and ((math.isfinite(am) and am > fmax) or
+                            (math.isinf(am) and explosive
+                             and all(r is not None for r in in_rngs))):
+                sev = "error" if prec in ("fp16", "bf16") else "warn"
+                add("HT801", sev,
+                    f"{node.op_type} {node.name}: derived interval "
+                    f"[{rng[0]:.3g}, {rng[1]:.3g}] exceeds {dt} max "
+                    f"{fmax:.3g} — overflow-prone in {prec} (shift the "
+                    f"operand, e.g. subtract the max before exp, or "
+                    f"compute in fp32)", node)
+
+        # HT802 — low-precision accumulation over N elements
+        if prec in ("fp16", "bf16"):
+            n_acc = None
+            if node.op_type in ("MatMulOp", "BatchMatMulOp"):
+                n_acc = _matmul_k(node, in_shapes)
+            elif node.op_type == "Conv2dOp" and in_shapes[1] is not None \
+                    and len(in_shapes[1]) == 4:
+                f = in_shapes[1]
+                n_acc = f[1] * f[2] * f[3]
+            elif node.op_type in ("ReduceSumOp", "ReduceMeanOp") \
+                    and in_shapes[0] is not None:
+                n_acc = 1
+                for ax in node.axes:
+                    if ax < len(in_shapes[0]):
+                        n_acc *= in_shapes[0][ax]
+            elif node.op_type == "ReduceSumAxisZeroOp" \
+                    and in_shapes[0]:
+                n_acc = in_shapes[0][0]
+            if n_acc is not None and accum_error_bound(dt, n_acc) > acc_tol:
+                add("HT802", "warn",
+                    f"{node.op_type} {node.name}: accumulates {n_acc} "
+                    f"elements in {prec} (worst-case relative error "
+                    f"{accum_error_bound(dt, n_acc):.2g} > {acc_tol:g})"
+                    f" — accumulate in fp32 "
+                    f"(preferred_element_type=jnp.float32) and cast the"
+                    f" result", node)
+
+        # HT803 — integer-exactness loss on the id paths
+        if node.op_type == "EmbeddingLookUp":
+            tbl, idx = node.inputs
+            rows = None
+            tshape = shapes.get(tbl) or getattr(tbl, "shape", None)
+            if tshape:
+                rows = tshape[0]
+            idt = dtypes.get(idx)
+            if idt is not None and idt.kind == "f":
+                limit = exact_int_limit(idt)
+                if rows is not None and rows > limit:
+                    add("HT803", "error",
+                        f"{node.name}: ids arrive as {idt} but the "
+                        f"table declares {rows} rows — float ids are "
+                        f"exact only to 2^{int(_finfo(idt).nmant) + 1}"
+                        f" = {limit}; feed integer ids", node)
+                else:
+                    add("HT803", "warn",
+                        f"{node.name}: float-dtype ids ({idt}) — "
+                        f"exactness is lost past {exact_int_limit(idt)}"
+                        f" ids; the runtime now rejects float id "
+                        f"feeds (feed int32/int64)", node)
+            elif idt is not None and idt.kind in "iu" and rows is not None \
+                    and rows - 1 > np.iinfo(idt).max:
+                add("HT803", "error",
+                    f"{node.name}: id dtype {idt} cannot address the "
+                    f"declared {rows}-row table — widen the id dtype",
+                    node)
+            elif rows is not None and rows - 1 > np.iinfo(np.int32).max:
+                import jax
+                if not jax.config.jax_enable_x64:
+                    add("HT803", "warn",
+                        f"{node.name}: the declared {rows}-row table "
+                        f"needs 64-bit ids, but jax x64 is disabled — "
+                        f"device feeds canonicalize int64 to int32 and "
+                        f"wrap; route the lookup through the PS host "
+                        f"path (64-bit ids end-to-end) or enable "
+                        f"jax_enable_x64", node)
+        if node.op_type == "CastOp" and prec in ("fp16", "bf16", "fp32"):
+            src = dtypes.get(node.inputs[0])
+            src_rng = in_rngs[0]
+            if src is not None and src.kind in "iu" \
+                    and src_rng is not None \
+                    and _absmax(src_rng) > exact_int_limit(dt):
+                add("HT803", "error",
+                    f"{node.name}: casts integers up to "
+                    f"{_absmax(src_rng):.3g} through {dt}, which is "
+                    f"exact only to {exact_int_limit(dt)} — ids pass "
+                    f"2^{int(_finfo(dt).nmant) + 1} and collide", node)
+
+    # HT807 — PRNG stream reuse across independent random ops
+    fams = {}
+    for node in topo:
+        if not (hasattr(node, "keep_prob") or hasattr(node, "rng_key")):
+            continue
+        fwd = getattr(node, "forward_node", None)
+        key = getattr(node, "rng_key", None)
+        if key is None:
+            key = fwd.id if fwd is not None else node.id
+        fam = fwd.id if fwd is not None else node.id
+        fams.setdefault(key, []).append((fam, node))
+    for key, members in fams.items():
+        owners = {}
+        for fam, node in members:
+            owners.setdefault(fam, node)
+        if len(owners) > 1:
+            names = ", ".join(n.name for n in owners.values())
+            first = next(iter(owners.values()))
+            if not any(_suppressed_node(n, "HT807")
+                       for n in owners.values()):
+                report.add(
+                    "HT807", "error",
+                    f"PRNG key {key} is consumed by {len(owners)} "
+                    f"independent random ops ({names}) — their masks "
+                    f"are CORRELATED, not independent; give each op "
+                    f"its own key (fold_in of a distinct op id)",
+                    node=first)
+
+    _config_checks(topo, report, ranges, dtypes, config, boundary_rtol,
+                   add)
+    return ranges
+
+
+def _config_checks(topo, report, ranges, dtypes, config, boundary_rtol,
+                   add):
+    """HT805/HT806 — mixed-precision flow checks that need the session
+    config (pipeline boundary dtype, executor compute dtype)."""
+    from ..optimizer import OptimizerOp
+
+    opt_nodes = [n for n in topo if isinstance(n, OptimizerOp)]
+
+    # HT806: backward path entirely in fp16 with no loss scale
+    cfg_dt = _np_dtype(getattr(config, "dtype", None)) \
+        if config is not None else None
+    for opt_op in opt_nodes:
+        fp16_grads = [g for g in opt_op.inputs
+                      if prec_class(dtypes.get(g)) == "fp16"]
+        all_fp16 = (cfg_dt is not None and cfg_dt.name == "float16") or \
+            (fp16_grads and len(fp16_grads) == len(opt_op.inputs))
+        if not all_fp16:
+            continue
+        scale = getattr(opt_op.optimizer, "loss_scale", None)
+        if scale is not None and scale > 1:
+            continue
+        sev = "warn"
+        tiny = dtype_tiny("float16")
+        small = [g for g in fp16_grads
+                 if ranges.get(g) is not None
+                 and _absmax(ranges[g]) < tiny]
+        if small:
+            sev = "error"
+        add("HT806", sev,
+            f"{opt_op.name}: the backward path runs entirely in fp16 "
+            f"with no loss scale — gradients below {tiny:.2g} (fp16 "
+            f"min-normal) flush to zero"
+            + (f"; {len(small)} gradient(s) derive an interval below "
+               f"it already" if small else "")
+            + " — pass loss_scale= to the optimizer (gradients are "
+              "unscaled inside the update)", opt_op)
+
+    if config is None:
+        return
+    ppo = getattr(config, "pp_options", None) or {}
+    bdt = _canon_low_prec(ppo.get("boundary_dtype"))
+    if getattr(config, "pipeline_mode", None) == "collective" and bdt:
+        if boundary_rtol is None:
+            boundary_rtol = ppo.get("boundary_rtol")
+        if boundary_rtol is None:
+            from ..parallel.collective_pp import BOUNDARY_RTOL
+            boundary_rtol = BOUNDARY_RTOL
+        hops = max(1, _stage_count(topo) - 1)
+        bound = boundary_error_bound(bdt, hops)
+        # through the suppression-aware closure: a deliberately
+        # retuned boundary gets waived with '# ht-ok: HT805' on the
+        # anchor's construction line like every other HT8xx finding
+        anchor = topo[-1]
+        if bound > boundary_rtol:
+            add("HT805", "error",
+                f"collective-pipeline boundary in {bdt}: derived "
+                f"relative error bound {bound:.2e} over {hops} hop(s) "
+                f"exceeds the declared tolerance {boundary_rtol:g} — "
+                f"retune boundary_rtol or keep fp32 boundaries",
+                anchor)
+        if bdt == "float16":
+            add("HT805", "warn",
+                f"collective-pipeline boundary widened to fp16: the "
+                f"exponent range halves (max {dtype_max(bdt):.0f}) — "
+                f"activations beyond it overflow at the stage "
+                f"boundary; verify measured activation absmax "
+                f"(rangecheck) and retune before shipping", anchor)
+
+    # HT805: explicit low-precision cross-replica reduction edges
+    for node in topo:
+        if node.op_type in ("AllReduceCommunicateOp",
+                            "GroupAllReduceCommunicateOp"):
+            prec = prec_class(dtypes.get(node))
+            if prec in ("bf16", "fp16"):
+                add("HT805", "warn",
+                    f"{node.name}: cross-replica reduction in {prec} — "
+                    f"per-hop relative error ~{dtype_eps(dtypes.get(node)) / 2:.2e} "
+                    f"compounds with replica count; reduce in fp32 or "
+                    f"declare the tolerance", node)
+
+
+# ---------------------------------------------------------------------------
+# CLI: zoo sweep gating on ANY unsuppressed finding
+# ---------------------------------------------------------------------------
+
+def check_zoo(names=None, measured_db=None):
+    """{model: Report} of numerics-only findings over zoo graphs."""
+    from . import zoo
+    from .findings import Report
+    from .shapes import shape_pass
+    from ..graph.autodiff import find_topo_sort
+
+    out = {}
+    for name in names or sorted(zoo.ZOO):
+        eval_nodes, feed_shapes = zoo.build(name)
+        topo = find_topo_sort(list(eval_nodes))
+        dtypes = {}
+        shapes = shape_pass(topo, Report(), feed_shapes=feed_shapes,
+                            dtypes_out=dtypes)
+        measured = None
+        if measured_db is not None:
+            measured = measured_db.get(name)
+        report = Report()
+        numerics_pass(topo, report, shapes=shapes, dtypes=dtypes,
+                      config=None, measured=measured)
+        out[name] = report
+    return out
+
+
+def main(argv=None):
+    import argparse
+    import json
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m hetu_tpu.analysis.numerics",
+        description="interval + dtype abstract interpretation over the "
+                    "zoo graphs (HT8xx); exits 1 on any unsuppressed "
+                    "finding")
+    parser.add_argument("models", nargs="*",
+                        help="zoo model names (default: all)")
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--db", default=None, metavar="PATH",
+                        help="measured-range DB (rangecheck output) "
+                             "that tightens the interval seeds")
+    args = parser.parse_args(argv)
+
+    from . import zoo
+    names = args.models or sorted(zoo.ZOO)
+    unknown = [n for n in names if n not in zoo.ZOO]
+    if unknown:
+        parser.error(f"unknown zoo model(s) {unknown}")
+
+    db = None
+    if args.db:
+        from .rangecheck import RangeDB
+        db = RangeDB(args.db)
+    reports = check_zoo(names, measured_db=db)
+    total = sum(len(r) for r in reports.values())
+    if args.json:
+        print(json.dumps(
+            {name: json.loads(r.to_json())
+             for name, r in reports.items()}, indent=2))
+    else:
+        for name, r in reports.items():
+            status = "FAIL" if len(r) else "ok"
+            print(f"== {name}: {status} ({len(r)} finding(s))")
+            for f in r.findings:
+                print("   " + str(f))
+        print(f"numerics: {total} unsuppressed finding(s) across "
+              f"{len(names)} zoo model(s)")
+    if total:
+        print("numerics: FAILED — guard the op, or waive with "
+              "'# ht-ok: HT8xx <reason>' on the construction line",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -46,6 +46,30 @@ def _node_dtype(node):
         return None
 
 
+def _result_dtype(node, in_dts):
+    """Static result dtype for one op: declared dtype for leaves and
+    casts, jax's promotion lattice over the known operand dtypes
+    otherwise (``jnp.promote_types`` — NOT numpy's, whose int+float
+    promotion would invent float64s the traced program never makes).
+    None when nothing is known. The numerics pass (HT8xx) rides this
+    to classify every node fp32/bf16/fp16/int."""
+    if node.op_type == "CastOp":
+        return _node_dtype(node)
+    if node.op_type == "OneHotOp":
+        return np.dtype(np.float32)     # jax.nn.one_hot(dtype=float32)
+    known = [d for d in in_dts if d is not None]
+    if not known:
+        return _node_dtype(node)
+    import jax.numpy as jnp
+    out = known[0]
+    for d in known[1:]:
+        try:
+            out = np.dtype(jnp.promote_types(out, d))
+        except TypeError:
+            return out
+    return np.dtype(out)
+
+
 def _resolve_feed_shapes(feed_shapes, topo):
     """Accept {node: shape} or {name: shape}; values may be a bare shape
     tuple or (shape, dtype)."""
@@ -69,8 +93,14 @@ def _resolve_feed_shapes(feed_shapes, topo):
 _MISSING = object()
 
 
-def shape_pass(topo, report, feed_shapes=None):
+def shape_pass(topo, report, feed_shapes=None, dtypes_out=None):
     """Propagate shapes/dtypes; returns {node: shape or None}.
+
+    ``dtypes_out`` (optional dict) receives ``{node: np.dtype or None}``
+    — the propagated result dtypes the numerics pass (HT8xx) reads as
+    its precision classes. Feed dtypes come from ``feed_shapes`` when
+    declared there (id feeds are routinely built as default-float32
+    Variables and fed integer arrays; the feed spec is the truth).
 
     Mirrors the executor's ``_infer_shapes`` protocol: gradient ops like
     ``BroadcastShapeGradSourceOp`` read a *non-input* forward node's
@@ -87,7 +117,7 @@ def shape_pass(topo, report, feed_shapes=None):
 
     feeds = _resolve_feed_shapes(feed_shapes, topo)
     shapes = {}
-    dtypes = {}
+    dtypes = dtypes_out if dtypes_out is not None else {}
     unknown = 0
     saved = {}
 
@@ -127,9 +157,8 @@ def shape_pass(topo, report, feed_shapes=None):
                 _mark(node, (in_shapes[0]
                              if isinstance(node, PipelineSendOp)
                              else None))
-                dtypes[node] = next(
-                    (dtypes.get(i) for i in node.inputs
-                     if dtypes.get(i) is not None), None)
+                dtypes[node] = _result_dtype(
+                    node, [dtypes.get(i) for i in node.inputs])
                 continue
             try:
                 _mark(node, tuple(node.infer_shape(list(in_shapes))))
@@ -166,7 +195,7 @@ def shape_pass(topo, report, feed_shapes=None):
                         f"dtype kinds {sorted(str(d) for d in known)} — "
                         f"the traced program will promote silently",
                         node=node)
-            dtypes[node] = known[0] if known else None
+            dtypes[node] = _result_dtype(node, in_dts)
     finally:
         for node, old in saved.values():
             if old is _MISSING:

@@ -22,7 +22,29 @@ class Dataloader:
     def __init__(self, raw_data, batch_size, name="default", func=None,
                  drop_last=True, shuffle=False):
         self.func = func if func else (lambda x: x)
-        self.raw_data = np.asarray(self.func(raw_data), np.float32)
+        arr = np.asarray(self.func(raw_data))
+        if arr.dtype.kind in "iu":
+            # preserve integer feeds (embedding/sparse ids): the old
+            # unconditional float32 cast silently destroyed id
+            # exactness past 2^24 — the HT803 cliff the numerics
+            # verifier now rejects at the lookup. int32 when the values
+            # fit (jax's default int width), int64 otherwise.
+            if arr.size == 0 or (arr.min() >= np.iinfo(np.int32).min
+                                 and arr.max() <= np.iinfo(np.int32).max):
+                arr = arr.astype(np.int32)
+            else:
+                import jax
+                if not jax.config.jax_enable_x64:
+                    import warnings
+                    warnings.warn(
+                        "Dataloader: integer values exceed int32; "
+                        "device feeds will canonicalize int64 to int32 "
+                        "and wrap (HT803) unless jax_enable_x64 is on "
+                        "— the PS host path handles 64-bit ids "
+                        "end-to-end", stacklevel=2)
+        else:
+            arr = arr.astype(np.float32)
+        self.raw_data = arr
         self.batch_size = int(batch_size)
         self.drop_last = drop_last
         self.shuffle = shuffle

@@ -664,6 +664,13 @@ class SubExecutor:
         # None and the compiled program is byte-identical to before.
         health_on = config.health_monitor is not None and training
         self._health_loss_name = None
+        # measured-range capture (analysis/rangecheck.py): when a
+        # RangeRecorder is attached, every float-valued node's
+        # (min, max) is reduced INSIDE the compiled step and returned
+        # in the auxiliary health pytree — the recorder fetches it at
+        # the sentinel cadence (two scalars per node, one device_get).
+        # Off (the default) the compiled program is unchanged.
+        range_on = bool(getattr(self, "_range_capture", False))
 
         def step_fn(params, state, opt_state, feeds, lr, step_idx, rng):
             # per-step key folded INSIDE the jit: an eager fold_in per
@@ -769,6 +776,24 @@ class SubExecutor:
                     # trace-time side effect: deterministic per build,
                     # read by the monitor for trip naming
                     self._health_loss_name = loss_node.name
+            if range_on:
+                rng_out = {}
+                for node in topo:
+                    v = env.get(node)
+                    if hasattr(v, "values"):    # IndexedSlices pytree
+                        v = v.values
+                    if v is None or not hasattr(v, "dtype") \
+                            or not hasattr(v, "shape") \
+                            or not jnp.issubdtype(v.dtype, jnp.floating) \
+                            or not all(isinstance(d, int) and d > 0
+                                       for d in v.shape):
+                        continue
+                    rng_out[node.name] = (
+                        jnp.min(v).astype(jnp.float32),
+                        jnp.max(v).astype(jnp.float32))
+                if health is None:
+                    health = {}
+                health["ranges"] = rng_out
             return outputs, new_params, new_state, new_opt, ps_grads, \
                 health
 
@@ -953,6 +978,10 @@ class SubExecutor:
             executor.opt_state = new_opt
         step0 = self.step_count
         self.step_count += nsteps
+        if health is not None:
+            # the aux pytree also carries the (stacked) rangecheck
+            # capture; the recorder reduces over the scan axis
+            self._last_health = health
         hm = self.config.health_monitor
         if hm is not None and health is not None:
             # sampled steps inside the block check from ONE fetch of
@@ -1069,9 +1098,12 @@ class SubExecutor:
             for opt in self.optimizer_ops:
                 opt.optimizer.lr_sched.step()
         self.step_count += 1
+        if health is not None:
+            # the aux pytree also carries the rangecheck capture, which
+            # runs without a health monitor — stash it unconditionally
+            self._last_health = health
         hm = self.config.health_monitor
         if hm is not None and health is not None:
-            self._last_health = health
             hm.after_step(self)
 
         results = []

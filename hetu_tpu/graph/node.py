@@ -139,6 +139,15 @@ class Op:
     def infer_shape(self, input_shapes):
         raise NotImplementedError
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        """Interval semantics for the HT8xx numerics verifier
+        (analysis/numerics.py): given per-input ``(lo, hi)`` bounds
+        (None = unknown), return a ``(lo, hi)`` bounding every element
+        of the output, or None for no claim. Ops with known value
+        semantics override (ops/*.py); shape-aware cases (matmul,
+        reductions, conv) are handled centrally by the pass."""
+        return None
+
     # ------------------------------------------------------------ scheduling
     def forward_hook(self, config):
         """Called in topo order during executor configuration

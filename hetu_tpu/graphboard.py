@@ -26,6 +26,12 @@ diagnostics: a node carrying an error gets a thick red border, a warn
 orange, an info blue, and the finding codes join the sublabel and
 tooltip — the graph view and ``Executor(validate=...)`` reading off one
 artifact.
+
+``ranges=`` (the ``analysis.numerics.numerics_pass`` output — a
+``{node_or_name: (lo, hi)}`` map) overlays the numerics verifier's
+derived value intervals: each covered node's sublabel gains
+``∈[lo, hi]`` plus its precision class from the node dtype, so an
+HT801/HT804 report can be read against the graph it indicts.
 """
 from __future__ import annotations
 
@@ -116,6 +122,31 @@ def _finding_map(findings):
     return out
 
 
+def _range_map(ranges, dtypes=None):
+    """Normalize the ``ranges=`` overlay input to
+    ``{op_name: (lo, hi, prec or None)}``. Accepts the numerics pass
+    output keyed by node objects, or a plain name-keyed dict; unknown
+    (None) intervals are dropped. ``dtypes`` (the shape pass's
+    propagated map) supplies the precision class — interior nodes
+    carry no declared ``.dtype``, so the declared attribute alone
+    would leave the advertised precision overlay blank everywhere the
+    HT802 reader needs it."""
+    if not ranges:
+        return {}
+    from .analysis.numerics import prec_class
+    dmap = {}
+    for key, dt in (dtypes or {}).items():
+        dmap[getattr(key, "name", None) or str(key)] = dt
+    out = {}
+    for key, rng in ranges.items():
+        if rng is None:
+            continue
+        name = getattr(key, "name", None) or str(key)
+        dt = dmap.get(name, getattr(key, "dtype", None))
+        out[name] = (float(rng[0]), float(rng[1]), prec_class(dt))
+    return out
+
+
 def _heat_color(frac):
     """0..1 -> pale yellow .. red fill."""
     lo, hi = (255, 252, 220), (214, 69, 48)
@@ -160,15 +191,18 @@ def _annotations(executor, topo):
     return out
 
 
-def to_dot(executor, costs=None, findings=None):
+def to_dot(executor, costs=None, findings=None, ranges=None,
+           dtypes=None):
     """Graphviz source for the session graph (reference
     graph2fig.py:11-23 builds the same node/edge list); ``costs``
-    overlays cost heat and ``findings`` the preflight diagnostics
-    exactly like ``render``."""
+    overlays cost heat, ``findings`` the preflight diagnostics and
+    ``ranges`` (+ ``dtypes``) the numerics intervals exactly like
+    ``render``."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap, dbinfo = _resolve_costs(costs, topo)
     fmap = _finding_map(findings)
+    rmap = _range_map(ranges, dtypes)
     max_cost = max(cmap.values()) if cmap else 0.0
     lines = ["digraph hetu {", "  rankdir=TB;",
              '  node [shape=box, fontsize=10];']
@@ -192,6 +226,11 @@ def to_dot(executor, costs=None, findings=None):
             color = _STAGE_COLORS[stage % len(_STAGE_COLORS)]
         else:
             color = "#eeeeee"
+        rng = rmap.get(node.name)
+        if rng is not None:
+            lo, hi, prec = rng
+            label += f"\\n∈[{lo:.3g}, {hi:.3g}]" + \
+                (f" {prec}" if prec else "")
         extra = ""
         hit = fmap.get(node.name)
         if hit is not None:
@@ -239,16 +278,21 @@ def _layout(topo):
     return coords, order
 
 
-def render(executor, path="graphboard.html", costs=None, findings=None):
+def render(executor, path="graphboard.html", costs=None, findings=None,
+           ranges=None, dtypes=None):
     """Write a standalone HTML/SVG of the graph (plus .dot beside it);
     returns the html path. ``costs`` (``profile_ops`` output or a
     {name: ms} dict) switches node fill to per-op cost heat;
     ``findings`` (an ``analysis.Report``) marks diagnosed nodes with a
-    severity-colored border and their HT codes."""
+    severity-colored border and their HT codes; ``ranges`` (the
+    numerics pass output) joins each node's derived interval to its
+    sublabel/tooltip, with ``dtypes`` (the shape pass's propagated
+    map) supplying the precision class."""
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap, dbinfo = _resolve_costs(costs, topo)
     fmap = _finding_map(findings)
+    rmap = _range_map(ranges, dtypes)
     max_cost = max(cmap.values()) if cmap else 0.0
     coords, order = _layout(topo)
 
@@ -294,6 +338,15 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
                 title += html.escape(" (cost DB hit)")
         elif dbinfo is not None and dbinfo.get(node.name) == "miss":
             title += html.escape(" — no cost DB entry")
+        rng = rmap.get(node.name)
+        rng_txt = None
+        if rng is not None:
+            lo, hi, prec = rng
+            rng_txt = f"[{lo:.2g},{hi:.2g}]" + \
+                (f" {prec}" if prec else "")
+            title += html.escape(
+                f"\n∈ [{lo:.4g}, {hi:.4g}]"
+                + (f" ({prec})" if prec else ""))
         hit = fmap.get(node.name)
         stroke, swidth, codes_txt = "#888", 1, None
         if hit is not None:
@@ -308,6 +361,7 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
             codes_txt,
             f"stage {stage}" if stage is not None else None,
             spec,
+            rng_txt,
             f"{cost:.2f} ms" if cost is not None else None) if x)
         parts.append(
             f'<g><title>{title}</title>'
@@ -329,7 +383,8 @@ def render(executor, path="graphboard.html", costs=None, findings=None):
     with open(path, "w") as f:
         f.write(page)
     with open(os.path.splitext(path)[0] + ".dot", "w") as f:
-        f.write(to_dot(executor, costs=costs, findings=findings))
+        f.write(to_dot(executor, costs=costs, findings=findings,
+                       ranges=ranges, dtypes=dtypes))
     return path
 
 
@@ -356,7 +411,7 @@ class ServerHandle(str):
 
 
 def show(executor, path="graphboard.html", port=None, costs=None,
-         findings=None):
+         findings=None, ranges=None, dtypes=None):
     """Render and (optionally) serve like the reference's graphboard
     (graph2fig.py:11-33). ``port=None`` skips the server; with a port
     the returned URL is a :class:`ServerHandle` whose ``shutdown()``
@@ -364,8 +419,11 @@ def show(executor, path="graphboard.html", port=None, costs=None,
     same for the last-started one). ``costs`` (``profile_ops`` output)
     overlays per-op cost heat coloring; ``findings`` (an
     ``analysis.Report``, e.g. ``executor.config.analysis_report``)
-    overlays preflight diagnostics."""
-    out = render(executor, path, costs=costs, findings=findings)
+    overlays preflight diagnostics; ``ranges`` (the numerics pass
+    output) + ``dtypes`` overlay derived intervals + precision
+    classes."""
+    out = render(executor, path, costs=costs, findings=findings,
+                 ranges=ranges, dtypes=dtypes)
     if port is None:
         return out
     import functools

@@ -46,6 +46,27 @@ class BaseInit:
         1=uniform(a,b), 2=normal(mean=a, std=b), 3=truncated normal."""
         return None
 
+    def interval(self):
+        """Static (lo, hi) bound on the initial draw — the numerics
+        verifier's interval seed (analysis/numerics.py). Constants and
+        uniforms are exact; normals are bounded at mean ± 4σ (the draw
+        escapes with probability < 1e-4 per element — the verifier
+        widens trainable seeds for training drift anyway) and truncated
+        normals at their hard ± 2σ clip. None when unknown."""
+        spec = self.dist_spec()
+        if spec is None:
+            return None
+        kind, a, b = spec
+        if kind == 0:
+            return (a, a)
+        if kind == 1:
+            return (a, b)
+        if kind == 2:
+            return (a - 4.0 * b, a + 4.0 * b)
+        if kind == 3:
+            return (a - 2.0 * b, a + 2.0 * b)
+        return None
+
     def __call__(self, name, trainable=True, dtype=np.float32, ctx=None):
         from .ops.variable import placeholder_op
         return placeholder_op(name, value=None, initializer=self,

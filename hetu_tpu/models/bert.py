@@ -154,9 +154,11 @@ class BertEmbeddings:
 
     def __call__(self, input_ids, token_type_ids, seq_len=None):
         seq_len = seq_len or self.seq_len
+        # int32, not the Variable default float32: float-dtype ids trip
+        # the HT803 exactness gate (embedding.check_id_dtype)
         position_ids = Variable(
             "position_ids", value=np.arange(seq_len).reshape(1, -1),
-            trainable=False)
+            trainable=False, dtype=np.int32)
         words = self.word_embeddings(input_ids)
         positions = self.position_embeddings(position_ids)
         token_types = self.token_type_embeddings(token_type_ids)

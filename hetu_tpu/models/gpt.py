@@ -312,9 +312,12 @@ class GPTModel:
 
     def __call__(self, input_ids, seq_len=None):
         seq_len = seq_len or self.seq_len
+        # int32, not the Variable default float32: float-dtype ids trip
+        # the HT803 exactness gate (embedding.check_id_dtype)
         position_ids = Variable(
             "gpt_position_ids",
-            value=np.arange(seq_len).reshape(1, -1), trainable=False)
+            value=np.arange(seq_len).reshape(1, -1), trainable=False,
+            dtype=np.int32)
         x = self.wte(input_ids)
         x = x + broadcastto_op(self.wpe(position_ids), x)
         x = self.dropout(x)

@@ -18,7 +18,7 @@ import numpy as np
 
 from .. import initializers as init
 from ..ops import (array_reshape_op, batch_matmul_op, broadcast_shape_op,
-                   broadcastto_op, concat_op, div_op, dropout_op,
+                   broadcastto_op, clip_op, concat_op, div_op, dropout_op,
                    embedding_lookup_op, layer_normalization_op, matmul_op,
                    mul_op, one_hot_op, reduce_sum_op, relu_op, softmax_op,
                    softmaxcrossentropy_op, transpose_op, where_op)
@@ -235,4 +235,8 @@ class Transformer:
         mask = where_op(target_ids, broadcastto_op(one, target_ids),
                         broadcastto_op(zero, target_ids))
         num = reduce_sum_op(mul_op(per_tok, mask), [0, 1])
-        return div_op(num, reduce_sum_op(mask, [0, 1]))
+        # clip the token count at 1: an all-pad batch made this a 0/0
+        # (the numerics verifier's HT804 finding); with >= 1 real
+        # token the clamp is the identity, all-pad now yields loss 0
+        count = clip_op(reduce_sum_op(mask, [0, 1]), 1.0, None)
+        return div_op(num, count)

@@ -67,7 +67,10 @@ class _Importer:
         if name in self.env:
             return self.env[name]
         value = self.const(name)
-        node = Variable(name, value=value,
+        # keep the initializer's dtype: the Variable default (float32)
+        # would silently float an imported id constant — the HT803
+        # exactness cliff the embedding lookup now rejects
+        node = Variable(name, value=value, dtype=value.dtype,
                         trainable=np.issubdtype(value.dtype,
                                                 np.floating))
         self.env[name] = node

@@ -12,7 +12,23 @@ import jax
 import jax.numpy as jnp
 
 from ..graph.node import Op
-from .basic import mul_op
+from .basic import mul_op, _iv_exp as _safe_exp
+
+# saturating activations (sigmoid/tanh/erf) ROUND to their asymptote in
+# finite precision long before float64 math reaches it: clamp a bound
+# within this slack of the asymptote onto it, else the static interval
+# wrongly excludes the saturated value (masking HT804's log/div-of-zero
+# detection and tripping the HT810 soundness gate on correct runs).
+# 5e-4 covers fp16's eps/2 rounding, the widest of the supported dtypes.
+_SATURATE_SLACK = 5e-4
+
+
+def _saturate(lo, hi, floor, ceil):
+    if lo - floor < _SATURATE_SLACK:
+        lo = floor
+    if ceil - hi < _SATURATE_SLACK:
+        hi = ceil
+    return (lo, hi)
 
 __all__ = [
     "relu_op", "relu_gradient_op", "leaky_relu_op", "leaky_relu_gradient_op",
@@ -36,6 +52,12 @@ class ReluOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        # interval semantics for the HT8xx numerics verifier (see
+        # ops/basic.py): (lo, hi) bound per input, None = unknown
+        a = input_ranges[0]
+        return None if a is None else (max(a[0], 0.0), max(a[1], 0.0))
+
 
 class ReluGradientOp(Op):
     """grad * (x > 0) — same input contract as the reference
@@ -54,6 +76,10 @@ class ReluGradientOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        g = input_ranges[1]
+        return None if g is None else (min(g[0], 0.0), max(g[1], 0.0))
+
 
 class LeakyReluOp(Op):
     def __init__(self, node_A, alpha, ctx=None):
@@ -70,6 +96,14 @@ class LeakyReluOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None:
+            return None
+        pts = (max(a[0], 0.0), max(a[1], 0.0),
+               self.alpha * min(a[0], 0.0), self.alpha * min(a[1], 0.0))
+        return (min(pts), max(pts))
 
 
 class LeakyReluGradientOp(Op):
@@ -105,6 +139,16 @@ class SigmoidOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None:
+            # sigmoid underflows to exactly 0.0/1.0 in finite precision:
+            # the closed interval is the honest bound (log(sigmoid(x))
+            # with very negative x genuinely NaNs — HT804 catches it)
+            return (0.0, 1.0)
+        return _saturate(1.0 / (1.0 + _safe_exp(-a[0])),
+                         1.0 / (1.0 + _safe_exp(-a[1])), 0.0, 1.0)
+
 
 class TanhOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -120,6 +164,13 @@ class TanhOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        import math
+        a = input_ranges[0]
+        if a is None:
+            return (-1.0, 1.0)
+        return _saturate(math.tanh(a[0]), math.tanh(a[1]), -1.0, 1.0)
 
 
 class GeluOp(Op):
@@ -138,6 +189,16 @@ class GeluOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        # gelu(x) in [-0.171, max(x, 0)]: the tanh-approximation (what
+        # compute runs) dips to -0.17004 at x ~ -0.75, so the bound
+        # must sit below it; bounded above by relu(x)
+        a = input_ranges[0]
+        if a is None:
+            return None
+        lo = -0.171 if a[0] < 0.0 else 0.0
+        return (lo, max(a[1], 0.0))
 
 
 class GeluGradientOp(Op):
@@ -169,6 +230,9 @@ class SignOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        return (-1.0, 1.0)
+
 
 class SoftmaxOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -182,6 +246,9 @@ class SoftmaxOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        return (0.0, 1.0)
 
 
 class SoftmaxGradientOp(Op):
@@ -199,6 +266,23 @@ class SoftmaxGradientOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        # |y (dy - sum(dy y))| <= |dy| + max|dy| <= 2 max|dy| since y is
+        # a probability row (sum 1, entries in [0, 1])
+        g = input_ranges[1]
+        if g is None:
+            return None
+        m = 2.0 * max(abs(g[0]), abs(g[1]))
+        return (-m, m)
+
+
+def _dropout_range(input_ranges, keep_prob):
+    """Mask elements are 0 or 1/keep_prob: hull of 0 and x/keep_prob."""
+    a = input_ranges[0]
+    if a is None or keep_prob <= 0:
+        return None
+    return (min(a[0] / keep_prob, 0.0), max(a[1] / keep_prob, 0.0))
 
 
 def _dropout_mask(ectx, op, keep_prob, shape, dtype, per_channel=False):
@@ -230,6 +314,9 @@ class DropoutOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        return _dropout_range(input_ranges, self.keep_prob)
+
 
 class DropoutGradientOp(Op):
     def __init__(self, node_in, keep_prob, forward_node, ctx=None):
@@ -251,6 +338,9 @@ class DropoutGradientOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        return _dropout_range(input_ranges, self.keep_prob)
+
 
 class Dropout2dOp(Op):
     def __init__(self, node_in, keep_prob, ctx=None):
@@ -271,6 +361,9 @@ class Dropout2dOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        return _dropout_range(input_ranges, self.keep_prob)
+
 
 class Dropout2dGradientOp(Op):
     def __init__(self, node_in, keep_prob, forward_node, ctx=None):
@@ -290,6 +383,9 @@ class Dropout2dGradientOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        return _dropout_range(input_ranges, self.keep_prob)
 
 
 # ---------------------------------------------------------------------------

@@ -8,6 +8,8 @@ exactly the fusion the reference's hand-written elementwise CUDA kernels
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -28,6 +30,48 @@ def _unbroadcast(grad_node, target_node):
     broadcastto ops; we keep that contract (elementwise ops require equal
     shapes) so the adjoint passes through unchanged."""
     return grad_node
+
+
+# ---------------------------------------------------------------------------
+# interval semantics (the HT8xx numerics verifier's transfer protocol)
+# ---------------------------------------------------------------------------
+# Ops may define ``infer_range(input_ranges, input_shapes=None)``
+# returning a (lo, hi) float pair bounding every element of the output
+# given per-input (lo, hi) bounds (None = unknown), mirroring the
+# ``infer_shape`` protocol. analysis/numerics.py walks the topo order
+# through it; ops without the method fall back to the central
+# shape-aware table there (matmul/conv/reductions need shapes).
+
+def _iv_sorted(lo, hi):
+    return (min(lo, hi), max(lo, hi))
+
+
+def _mul_ep(x, y):
+    """Endpoint product with the standard interval-arithmetic rule
+    0 * inf := 0 — a naive product NaNs there, and a (nan, nan)
+    interval silently disarms every downstream HT801/HT804 check
+    (half-bounded intervals from one-sided clips make this reachable
+    in ordinary graphs)."""
+    if x == 0.0 or y == 0.0:
+        return 0.0
+    return x * y
+
+
+def _iv_mul(a, b):
+    if any(v != v for v in (*a, *b)):   # NaN endpoint: no claim
+        return None
+    ps = (_mul_ep(a[0], b[0]), _mul_ep(a[0], b[1]),
+          _mul_ep(a[1], b[0]), _mul_ep(a[1], b[1]))
+    return (min(ps), max(ps))
+
+
+def _iv_exp(x):
+    if x >= 709.0:                  # float64 exp overflow knee
+        return float("inf")
+    try:
+        return math.exp(x)
+    except OverflowError:
+        return float("inf")
 
 
 class AddOp(Op):
@@ -65,6 +109,12 @@ class AddOp(Op):
         assert tuple(a) == tuple(b), f"add shape mismatch {a} vs {b}"
         return a
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a, b = input_ranges
+        if a is None or b is None:
+            return None
+        return (a[0] + b[0], a[1] + b[1])
+
 
 class AddByConstOp(Op):
     def __init__(self, node_A, const_val, ctx=None):
@@ -79,6 +129,14 @@ class AddByConstOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        try:
+            c = float(self.const_attr)
+        except (TypeError, ValueError):
+            return None
+        return None if a is None else (a[0] + c, a[1] + c)
 
 
 class MulOp(Op):
@@ -101,6 +159,19 @@ class MulOp(Op):
         assert tuple(a) == tuple(b), f"mul shape mismatch {a} vs {b}"
         return a
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a, b = input_ranges
+        if a is None or b is None:
+            return None
+        if self.inputs[0] is self.inputs[1]:
+            # x * x is a square, not an interval product: correlation-
+            # blind arithmetic would sign-flip it and hide every
+            # "square + eps" zero-exclusion guard (HT804's bread)
+            lo = 0.0 if a[0] <= 0.0 <= a[1] else min(a[0] * a[0],
+                                                     a[1] * a[1])
+            return (lo, max(a[0] * a[0], a[1] * a[1]))
+        return _iv_mul(a, b)
+
 
 class MulByConstOp(Op):
     def __init__(self, node_A, const_val, ctx=None):
@@ -116,6 +187,14 @@ class MulByConstOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        try:
+            c = float(self.const_attr)
+        except (TypeError, ValueError):
+            return None
+        return None if a is None else _iv_sorted(a[0] * c, a[1] * c)
 
 
 class DivOp(Op):
@@ -143,6 +222,12 @@ class DivOp(Op):
         assert tuple(a) == tuple(b)
         return a
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a, b = input_ranges
+        if a is None or b is None or (b[0] <= 0.0 <= b[1]):
+            return None           # zero-crossing denominator: HT804's job
+        return _iv_mul(a, (1.0 / b[1], 1.0 / b[0]))
+
 
 class DivConstOp(Op):
     """const / node (reference Division.py DivConstOp)."""
@@ -163,6 +248,16 @@ class DivConstOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        try:
+            c = float(self.const_attr)
+        except (TypeError, ValueError):
+            return None
+        if a is None or (a[0] <= 0.0 <= a[1]):
+            return None
+        return _iv_sorted(c / a[1], c / a[0])
 
 
 class DivHandleZeroOp(Op):
@@ -195,6 +290,10 @@ class OppositeOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        return None if a is None else (-a[1], -a[0])
+
 
 class SqrtOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -211,6 +310,14 @@ class SqrtOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None:
+            return None
+        # bound over the defined (x >= 0) region; a negative lo is
+        # HT804's finding, not this bound's
+        return (math.sqrt(max(a[0], 0.0)), math.sqrt(max(a[1], 0.0)))
 
 
 class ErfOp(Op):
@@ -234,6 +341,13 @@ class ErfOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        from .activations import _saturate
+        a = input_ranges[0]
+        if a is None:
+            return (-1.0, 1.0)
+        return _saturate(math.erf(a[0]), math.erf(a[1]), -1.0, 1.0)
+
 
 class ReciprocalSqrtOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -251,6 +365,12 @@ class ReciprocalSqrtOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None or a[0] <= 0.0:
+            return None           # zero/negative operand: HT804's job
+        return (1.0 / math.sqrt(a[1]), 1.0 / math.sqrt(a[0]))
+
 
 class ExpOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -265,6 +385,14 @@ class ExpOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None:
+            return None
+        # inf upper bound is exactly what HT801 wants to see for an
+        # un-shifted exp whose operand reaches the overflow knee
+        return (_iv_exp(a[0]), _iv_exp(a[1]))
+
 
 class LogOp(Op):
     def __init__(self, node_A, ctx=None):
@@ -278,6 +406,12 @@ class LogOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None or a[0] <= 0.0:
+            return None           # log of a zero-reaching operand: HT804
+        return (math.log(a[0]), math.log(a[1]))
 
 
 class AbsOp(Op):
@@ -294,6 +428,13 @@ class AbsOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        if a is None:
+            return None
+        lo = 0.0 if a[0] <= 0.0 <= a[1] else min(abs(a[0]), abs(a[1]))
+        return (lo, max(abs(a[0]), abs(a[1])))
 
 
 class PowerOp(Op):
@@ -312,6 +453,21 @@ class PowerOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a = input_ranges[0]
+        p = self.p
+        if a is None or p != int(p) or p < 0:
+            return None           # negative p over a zero crossing: HT804
+        p = int(p)
+        try:
+            vals = (a[0] ** p, a[1] ** p)
+        except OverflowError:
+            return (0.0 if p % 2 == 0 else -float("inf"), float("inf"))
+        if p % 2 == 0:
+            lo = 0.0 if a[0] <= 0.0 <= a[1] else min(vals)
+            return (lo, max(vals))
+        return _iv_sorted(*vals)
 
 
 class WhereOp(Op):
@@ -332,6 +488,12 @@ class WhereOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[1]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        _, a, b = input_ranges
+        if a is None or b is None:
+            return None
+        return (min(a[0], b[0]), max(a[1], b[1]))
+
 
 class OneHotOp(Op):
     def __init__(self, node, num_classes, ctx=None):
@@ -348,6 +510,9 @@ class OneHotOp(Op):
 
     def infer_shape(self, input_shapes):
         return tuple(input_shapes[0]) + (self.num_classes,)
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        return (0.0, 1.0)
 
 
 class MatrixDotOp(Op):
@@ -368,6 +533,12 @@ class MatrixDotOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        a, b = input_ranges
+        if a is None or b is None:
+            return None
+        return _iv_mul(a, b)
 
 
 class CastOp(Op):
@@ -391,6 +562,12 @@ class CastOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        # the value interval survives the cast unchanged; whether the
+        # TARGET dtype can represent it is HT801's check, which reads
+        # this op's (unclamped) interval against self.dtype's max
+        return input_ranges[0]
+
 
 class ClipOp(Op):
     """Clamp to [min_val, max_val]; gradient is masked to the interior
@@ -411,6 +588,21 @@ class ClipOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        # a half-bounded result (e.g. [1e-12, inf) from a one-sided
+        # clip of an unknown operand) still carries the zero-exclusion
+        # guard HT804 looks for
+        a = input_ranges[0]
+        lo = -float("inf") if a is None else a[0]
+        hi = float("inf") if a is None else a[1]
+        if self.min_val is not None:
+            lo = max(lo, float(self.min_val))
+            hi = max(hi, float(self.min_val))
+        if self.max_val is not None:
+            hi = min(hi, float(self.max_val))
+            lo = min(lo, float(self.max_val))
+        return (lo, hi)
 
 
 class ClipMaskOp(Op):
@@ -433,6 +625,9 @@ class ClipMaskOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        return (0.0, 1.0)
 
 
 # ---------------------------------------------------------------------------

@@ -10,12 +10,47 @@ keeping the slices sparse all the way to the update (or to the PS client).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..graph.node import Op
 from ..ndarray import IndexedSlices
 
 __all__ = ["embedding_lookup_op", "embedding_lookup_gradient_op",
-           "EmbeddingLookUp", "EmbeddingLookUpGradient"]
+           "EmbeddingLookUp", "EmbeddingLookUpGradient", "check_id_dtype"]
+
+
+def check_id_dtype(dtype, rows, what):
+    """The HT803 runtime twin: reject id feeds whose dtype cannot
+    address the table exactly. Float ids represent integers exactly
+    only up to 2^mantissa (float32: 2^24 ≈ 16.8M — far below the
+    trillion-row PS roadmap), so they are rejected outright instead of
+    the old silent ``astype(int32)``; an integer dtype narrower than
+    the declared row count is the same cliff at 2^31."""
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        raise TypeError(
+            f"{what}: ids arrived as {dtype} — float ids lose integer "
+            f"exactness past 2^{jnp.finfo(dtype).nmant + 1} and are "
+            f"rejected (HT803); feed an integer id array")
+    if rows is not None and jnp.issubdtype(dtype, jnp.integer) \
+            and int(rows) - 1 > int(jnp.iinfo(dtype).max):
+        raise ValueError(
+            f"{what}: id dtype {dtype} cannot address the declared "
+            f"{rows}-row table (HT803); widen the id dtype")
+
+
+def _canon_ids(idx, rows):
+    """int32 when the table fits (the historical layout every consumer
+    expects); ids for a table past 2^31 rows keep their wide dtype —
+    the old unconditional astype(int32) wrapped them negative, the
+    silent-wrong twin of the float cliff check_id_dtype just cleared.
+    NOTE: the wide path only carries real int64 under jax_enable_x64
+    (default-x64-off jax canonicalizes device int64 to int32 before
+    compute ever sees it — HT803 warns statically); the PS *host*
+    path is 64-bit end-to-end regardless."""
+    if rows is not None and int(rows) - 1 > np.iinfo(np.int32).max:
+        return idx
+    return idx.astype(jnp.int32)
 
 
 class EmbeddingLookUp(Op):
@@ -27,7 +62,8 @@ class EmbeddingLookUp(Op):
 
     def compute(self, input_vals, ectx):
         table, idx = input_vals
-        return jnp.take(table, idx.astype(jnp.int32), axis=0)
+        check_id_dtype(idx.dtype, table.shape[0], "embedding lookup")
+        return jnp.take(table, _canon_ids(idx, table.shape[0]), axis=0)
 
     def gradient(self, output_grad):
         grad = embedding_lookup_gradient_op(
@@ -37,6 +73,10 @@ class EmbeddingLookUp(Op):
     def infer_shape(self, input_shapes):
         emb_shape, idx_shape = input_shapes
         return tuple(idx_shape) + (emb_shape[-1],)
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        # gathered rows are a subset of the table
+        return input_ranges[0]
 
     def deduce_states(self, input_statuses, status, deduce_order):
         """Output [*idx_dims, D]: index splits pass through the leading
@@ -73,7 +113,9 @@ class EmbeddingLookUpGradient(Op):
 
     def compute(self, input_vals, ectx):
         grad, idx = input_vals
-        return IndexedSlices(indices=idx.astype(jnp.int32), values=grad,
+        rows = self.embed_shape[0] if self.embed_shape else None
+        check_id_dtype(idx.dtype, rows, "embedding gradient scatter")
+        return IndexedSlices(indices=_canon_ids(idx, rows), values=grad,
                              dense_shape=self.embed_shape)
 
     def gradient(self, output_grad):

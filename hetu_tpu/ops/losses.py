@@ -19,6 +19,32 @@ __all__ = [
     "crossentropy_op",
 ]
 
+# predictions/probabilities are clipped to [_PROB_EPS, 1 - _PROB_EPS]
+# before any log/div — both in the BCE/CE compute bodies and in the
+# gradient graphs, so neither direction can divide by (or log) zero
+_PROB_EPS = 1e-12
+
+
+def _label_on_simplex(label_range):
+    """The CE bounds assume labels form a distribution (entries in
+    [0, 1]); a KNOWN label interval outside that is off-contract —
+    the transfer makes no claim rather than an unsound one."""
+    return label_range is None or (label_range[0] >= 0.0
+                                   and label_range[1] <= 1.0)
+
+
+def _ce_range(logit_range, input_shapes, label_range=None):
+    """[0, 2 max|logit| + ln C] — max_j l_j - min_j l_j + ln C bounds
+    logsumexp(l) - l_label for any label distribution on the simplex."""
+    import math
+    if logit_range is None or not _label_on_simplex(label_range):
+        return None
+    c = None
+    if input_shapes and input_shapes[0]:
+        c = input_shapes[0][-1]
+    m = max(abs(logit_range[0]), abs(logit_range[1]))
+    return (0.0, 2.0 * m + math.log(float(c if c else 2)))
+
 
 class SoftmaxCrossEntropyOp(Op):
     """Per-example CE of logits (node_A) vs one-hot/soft labels (node_B);
@@ -41,6 +67,12 @@ class SoftmaxCrossEntropyOp(Op):
         shape = tuple(input_shapes[0][:-1])
         return shape if shape else (1,)
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        # interval semantics for the HT8xx numerics verifier: per-example
+        # CE of C-way logits is within [0, 2 max|logit| + ln C]
+        return _ce_range(input_ranges[0], input_shapes,
+                         label_range=input_ranges[1])
+
 
 class SoftmaxCrossEntropyGradientOp(Op):
     def __init__(self, node_A, node_B, grad_node, ctx=None):
@@ -56,6 +88,15 @@ class SoftmaxCrossEntropyGradientOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        _, labels, grad = input_ranges
+        if grad is None:
+            return None
+        lm = 1.0 if labels is None else max(1.0, abs(labels[0]),
+                                            abs(labels[1]))
+        m = (1.0 + lm) * max(abs(grad[0]), abs(grad[1]))
+        return (-m, m)
 
 
 class SoftmaxCrossEntropySparseOp(Op):
@@ -87,6 +128,9 @@ class SoftmaxCrossEntropySparseOp(Op):
         shape = tuple(input_shapes[0][:-1])
         return shape if shape else (1,)
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        return _ce_range(input_ranges[0], input_shapes)
+
 
 class SoftmaxCrossEntropySparseGradientOp(Op):
     def __init__(self, node_A, node_B, node_C, ignored_index=-1, ctx=None):
@@ -110,6 +154,14 @@ class SoftmaxCrossEntropySparseGradientOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        grad = input_ranges[2]
+        if grad is None:
+            return None
+        # |softmax - onehot| <= 1 elementwise
+        m = max(abs(grad[0]), abs(grad[1]))
+        return (-m, m)
+
 
 class BinaryCrossEntropyOp(Op):
     """Elementwise BCE of predictions (node_A, already in (0,1)) vs labels
@@ -120,8 +172,7 @@ class BinaryCrossEntropyOp(Op):
 
     def compute(self, input_vals, ectx):
         pred, label = input_vals
-        eps = 1e-12
-        pred = jnp.clip(pred, eps, 1 - eps)
+        pred = jnp.clip(pred, _PROB_EPS, 1 - _PROB_EPS)
         return -(label * jnp.log(pred) + (1 - label) * jnp.log(1 - pred))
 
     def gradient(self, output_grad):
@@ -132,6 +183,13 @@ class BinaryCrossEntropyOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        import math
+        label = input_ranges[1]
+        if not _label_on_simplex(label):
+            return None     # off-[0,1] labels make BCE go negative
+        return (0.0, 2.0 * -math.log(_PROB_EPS))
+
 
 class BinaryCrossEntropyGradientOp(Op):
     def __init__(self, node_A, node_B, node_C, ctx=None):
@@ -140,8 +198,7 @@ class BinaryCrossEntropyGradientOp(Op):
 
     def compute(self, input_vals, ectx):
         pred, label, grad = input_vals
-        eps = 1e-12
-        pred = jnp.clip(pred, eps, 1 - eps)
+        pred = jnp.clip(pred, _PROB_EPS, 1 - _PROB_EPS)
         return grad * (pred - label) / (pred * (1 - pred))
 
     def gradient(self, output_grad):
@@ -149,6 +206,18 @@ class BinaryCrossEntropyGradientOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        grad = input_ranges[2]
+        if grad is None:
+            return None
+        # |pred - label| / (pred (1 - pred)) <= (1 + |label|) / eps with
+        # pred clipped to [eps, 1 - eps]
+        label = input_ranges[1]
+        lm = 1.0 if label is None else max(1.0, abs(label[0]),
+                                           abs(label[1]))
+        m = max(abs(grad[0]), abs(grad[1])) * (1.0 + lm) / _PROB_EPS
+        return (-m, m)
 
 
 class CrossEntropyOp(Op):
@@ -159,19 +228,33 @@ class CrossEntropyOp(Op):
 
     def compute(self, input_vals, ectx):
         probs, labels = input_vals
-        return -jnp.sum(labels * jnp.log(jnp.clip(probs, 1e-12, None)),
+        return -jnp.sum(labels * jnp.log(jnp.clip(probs, _PROB_EPS, None)),
                         axis=-1)
 
     def gradient(self, output_grad):
-        from .basic import div_op, opposite_op, mul_op
+        from .basic import clip_op, div_op, opposite_op, mul_op
         from .shape import broadcastto_op
-        d = opposite_op(div_op(self.inputs[1], self.inputs[0]))
+        # clip the denominator exactly like the forward's log argument:
+        # softmax probabilities legitimately underflow to 0.0, and the
+        # unguarded -labels/probs was this repo's own HT804 finding
+        d = opposite_op(div_op(self.inputs[1],
+                               clip_op(self.inputs[0], _PROB_EPS, None)))
         g = broadcastto_op(output_grad, self.inputs[0])
         return [mul_op(d, g, ctx=self.raw_ctx), None]
 
     def infer_shape(self, input_shapes):
         shape = tuple(input_shapes[0][:-1])
         return shape if shape else (1,)
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        import math
+        labels = input_ranges[1]
+        if not _label_on_simplex(labels):
+            return None     # negative labels flip the sum's sign
+        c = 2
+        if input_shapes and input_shapes[0]:
+            c = input_shapes[0][-1]
+        return (0.0, float(c) * -math.log(_PROB_EPS))
 
 
 def softmaxcrossentropy_op(node_A, node_B, use_cudnn=True, ctx=None):

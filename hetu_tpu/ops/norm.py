@@ -34,6 +34,23 @@ def _bcast_c(v):
     return v.reshape(1, -1, 1, 1)
 
 
+def _norm_range(n, scale_range, bias_range):
+    """Interval semantics for the HT8xx numerics verifier: a value
+    standardized over ``n`` samples satisfies |x - mean| / std <=
+    sqrt(n - 1), so the affine output is bounded by
+    sqrt(n) * |scale| + |bias| regardless of the input's range (the
+    eps > 0 contract keeps the rsqrt finite; eps <= 0 is HT804)."""
+    import math
+    if scale_range is None:
+        return None
+    k = math.sqrt(float(max(n, 1)))
+    sm = max(abs(scale_range[0]), abs(scale_range[1]))
+    bm = 0.0 if bias_range is None else max(abs(bias_range[0]),
+                                            abs(bias_range[1]))
+    m = k * sm + bm
+    return (-m, m)
+
+
 class BatchNormalizationOp(Op):
     def __init__(self, node_in, bn_scale, bn_bias, momentum=0.99, eps=0.01,
                  ctx=None):
@@ -82,6 +99,13 @@ class BatchNormalizationOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        n = 1
+        if input_shapes and input_shapes[0] and len(input_shapes[0]) == 4:
+            s = input_shapes[0]
+            n = s[0] * s[2] * s[3]
+        return _norm_range(n, input_ranges[1], input_ranges[2])
 
 
 class BatchNormalizationGradientOp(Op):
@@ -195,6 +219,12 @@ class LayerNormalizationOp(Op):
     def infer_shape(self, input_shapes):
         return input_shapes[0]
 
+    def infer_range(self, input_ranges, input_shapes=None):
+        n = 1
+        if input_shapes and input_shapes[0]:
+            n = input_shapes[0][-1]
+        return _norm_range(n, input_ranges[1], input_ranges[2])
+
 
 class LayerNormalizationGradientOp(Op):
     def __init__(self, out_gradient, in_node, ln_scale, forward_node, eps,
@@ -275,6 +305,12 @@ class InstanceNormalization2dOp(Op):
 
     def infer_shape(self, input_shapes):
         return input_shapes[0]
+
+    def infer_range(self, input_ranges, input_shapes=None):
+        n = 1
+        if input_shapes and input_shapes[0] and len(input_shapes[0]) == 4:
+            n = input_shapes[0][2] * input_shapes[0][3]
+        return _norm_range(n, (1.0, 1.0), None)
 
 
 class InstanceNormalization2dGradientOp(Op):

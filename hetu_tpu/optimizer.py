@@ -51,7 +51,7 @@ def sentinel_stats(param, grad, new_param):
 class Optimizer:
     name = "Optimizer"
 
-    def __init__(self, learning_rate, l2reg=0):
+    def __init__(self, learning_rate, l2reg=0, loss_scale=None):
         if isinstance(learning_rate, FixedScheduler):
             self.lr_sched = learning_rate
         else:
@@ -59,6 +59,13 @@ class Optimizer:
             self.lr_sched = FixedScheduler(learning_rate)
         assert l2reg >= 0
         self.l2reg = l2reg
+        # static loss scaling (Micikevicius et al.): ``minimize`` builds
+        # the gradients of loss_scale * loss so an fp16 backward stays
+        # above min-normal, and ``update`` unscales them before the
+        # parameter step — exact in fp32 master math. Worker-local
+        # only; the HT806 check names this knob as the remediation.
+        assert loss_scale is None or loss_scale > 0
+        self.loss_scale = loss_scale
         self.params = None
         self.initiated = False
 
@@ -90,7 +97,15 @@ class Optimizer:
         if not var_list:
             var_list = self.get_var_list(loss)
         self.params = var_list
-        grads = gradients(loss, self.params)
+        target = loss
+        if self.loss_scale and self.loss_scale != 1:
+            from .ops.basic import mul_byconst_op
+            s = float(self.loss_scale)
+            if isinstance(loss, list):
+                target = [mul_byconst_op(l, s) for l in loss]
+            else:
+                target = mul_byconst_op(loss, s)
+        grads = gradients(target, self.params)
         return OptimizerOp(grads, self)
 
     # ------------------------------------------------------- functional API
@@ -99,9 +114,28 @@ class Optimizer:
         return {}
 
     def _apply_l2(self, param, grad):
+        # unscale here, not in update(): every update path — update(),
+        # the staged-pipeline driver, collective_pp's direct
+        # update_one — funnels raw grads through _apply_l2 exactly
+        # once, and l2 must apply to the UNSCALED gradient
+        grad = self._unscale(grad)
         if self.l2reg > 0 and not isinstance(grad, IndexedSlices):
             return grad + self.l2reg * param
         return grad
+
+    def _unscale(self, grad):
+        """Divide the loss-scaled gradient back down (in the master
+        dtype — the scale's whole point is that the division happens
+        AFTER the fp16 backward, not inside it)."""
+        s = self.loss_scale
+        if not s or s == 1:
+            return grad
+        inv = 1.0 / float(s)
+        if isinstance(grad, IndexedSlices):
+            return IndexedSlices(indices=grad.indices,
+                                 values=grad.values * inv,
+                                 dense_shape=grad.dense_shape)
+        return grad * inv
 
     def update_one(self, param, grad, slots, lr, step):
         """(new_param, new_slots) for one parameter."""
@@ -137,8 +171,8 @@ class MomentumOptimizer(Optimizer):
     name = "Momentum"
 
     def __init__(self, learning_rate=0.01, momentum=0.9, nesterov=False,
-                 l2reg=0):
-        super().__init__(learning_rate, l2reg)
+                 l2reg=0, loss_scale=None):
+        super().__init__(learning_rate, l2reg, loss_scale)
         self.momentum = momentum
         self.nesterov = nesterov
 
@@ -161,8 +195,8 @@ class AdaGradOptimizer(Optimizer):
     name = "AdaGrad"
 
     def __init__(self, learning_rate=0.01, initial_accumulator_value=0.0,
-                 eps=1e-7, l2reg=0):
-        super().__init__(learning_rate, l2reg)
+                 eps=1e-7, l2reg=0, loss_scale=None):
+        super().__init__(learning_rate, l2reg, loss_scale)
         self.initial_accumulator_value = initial_accumulator_value
         self.eps = eps
 
@@ -191,8 +225,8 @@ class AdamOptimizer(Optimizer):
     name = "Adam"
 
     def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
-                 epsilon=1e-7, l2reg=0, amsgrad=False):
-        super().__init__(learning_rate, l2reg)
+                 epsilon=1e-7, l2reg=0, amsgrad=False, loss_scale=None):
+        super().__init__(learning_rate, l2reg, loss_scale)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
@@ -250,8 +284,10 @@ class AdamWOptimizer(AdamOptimizer):
     name = "AdamW"
 
     def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
-                 epsilon=1e-7, weight_decay=0.01, l2reg=0):
-        super().__init__(learning_rate, beta1, beta2, epsilon, l2reg)
+                 epsilon=1e-7, weight_decay=0.01, l2reg=0,
+                 loss_scale=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, l2reg,
+                         loss_scale=loss_scale)
         self.weight_decay = weight_decay
 
     def update_one(self, param, grad, slots, lr, step):
@@ -325,8 +361,12 @@ class OptimizerOp(Op):
             # count / update ratio, captured at trace time and returned
             # from the step as one auxiliary pytree (telemetry/health)
             for node, pval in param_vals.items():
+                # sentinel the UNSCALED gradient: with loss_scale set
+                # the raw grads are scale-times reality, which would
+                # poison every grad_norm the health monitor records
                 sentinels.append((node.name, sentinel_stats(
-                    pval, grad_vals[node], new_params.get(node, pval))))
+                    pval, opt._unscale(grad_vals[node]),
+                    new_params.get(node, pval))))
         ectx.new_params.update(new_params)
         ectx.new_opt_state = {**(ectx.opt_state or {}), **new_state}
         return jnp.zeros((1,), dtype=jnp.float32)
@@ -347,6 +387,14 @@ class OptimizerOp(Op):
         new_inputs = []
         for grad, param in zip(self.inputs, self.optimizer.params):
             strategy = config.node_strategy.get(param) or config.comm_mode
+            if strategy in ("PS", "Hybrid") and \
+                    (self.optimizer.loss_scale or 1) != 1:
+                # a PS-pushed gradient bypasses update()'s unscale and
+                # would apply loss_scale-times too large server-side
+                raise ValueError(
+                    "loss_scale is worker-local (unscaled inside the "
+                    "optimizer update); it cannot be combined with "
+                    "PS-pushed gradients")
             if getattr(param, "device_cached", False):
                 # HET device-cache path: the worker optimizer applies the
                 # local sparse update in-graph; accumulated grads drain to

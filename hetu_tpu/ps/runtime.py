@@ -333,23 +333,34 @@ class PSRuntime:
                 host_feeds[dl] = host_val
             feed_map[dl] = dev_val
 
-        def host_ids(index_node, what):
+        def host_ids(index_node, what, rows=None):
+            from ..ops.embedding import check_id_dtype
             if index_node in host_feeds:
-                return np.asarray(host_feeds[index_node])
-            from ..dataloader import DataloaderOp, GNNDataLoaderOp
-            if isinstance(index_node, (DataloaderOp, GNNDataLoaderOp)) \
+                idx = np.asarray(host_feeds[index_node])
+            elif _detached_loader(index_node) \
                     and index_node not in feed_map:
                 # ids dataloader detached from the graph by the cache
                 # rewrite: drive it from here
                 value = index_node.get_arr(sub.name)
                 host_feeds[index_node] = np.asarray(value)
-                return host_feeds[index_node]
-            if index_node in feed_map:
+                idx = host_feeds[index_node]
+            elif index_node in feed_map:
                 # device-resident ids: one readback round trip
-                return np.asarray(jax.device_get(feed_map[index_node]))
-            raise RuntimeError(
-                f"PS {what} requires its indices to be a feed or "
-                f"dataloader output")
+                idx = np.asarray(jax.device_get(feed_map[index_node]))
+            else:
+                raise RuntimeError(
+                    f"PS {what} requires its indices to be a feed or "
+                    f"dataloader output")
+            # HT803's runtime twin: float ids silently truncate past
+            # 2^24 and an id dtype narrower than the declared table is
+            # the same cliff at 2^31 — reject instead of astype
+            check_id_dtype(idx.dtype, rows, f"PS {what}")
+            return idx
+
+        def _detached_loader(index_node):
+            from ..dataloader import DataloaderOp, GNNDataLoaderOp
+            return isinstance(index_node, (DataloaderOp,
+                                           GNNDataLoaderOp))
 
         # 0. device-cache path: ids -> slots, fill misses/stale rows with
         # async dispatches (data dependency orders them before the step)
@@ -358,7 +369,8 @@ class PSRuntime:
         hm = self.config.health_monitor
         for rt, ids_node, slots_node in cached:
             with self._phase("slot_assign"):
-                ids = host_ids(ids_node, "device-cached lookup")
+                ids = host_ids(ids_node, "device-cached lookup",
+                               rows=getattr(rt, "rows", None))
                 if hm is not None:
                     hm.observe_ids(rt.tid, ids)   # hot-key skew
                 slots, miss_ids, miss_slots, uniq_slots = rt.assign(
@@ -406,7 +418,8 @@ class PSRuntime:
                                                       dirty)
                 continue
             with self._phase("host_pull"):
-                idx = host_ids(lk.inputs[1], "embedding lookup")
+                idx = host_ids(lk.inputs[1], "embedding lookup",
+                               rows=int(lk.inputs[0].shape[0]))
                 if hm is not None:
                     hm.observe_ids(lk.inputs[0].id, idx)
                 width = int(lk.inputs[0].shape[-1])
@@ -427,7 +440,8 @@ class PSRuntime:
                 feed_map[op] = self._settle_spec_pull(spec_pulls[op],
                                                       dirty)
                 continue
-            idx = host_ids(op.inputs[0], "sparse pull")
+            idx = host_ids(op.inputs[0], "sparse pull",
+                           rows=int(op.parameter.shape[0]))
             if hm is not None:
                 hm.observe_ids(op.parameter.id, idx)
             width = int(op.parameter.shape[-1])
@@ -602,6 +616,8 @@ class PSRuntime:
     def _spec_pull(self, tid, idx, width):
         """One speculative SparsePull (dedup'd), plus everything needed
         to revalidate and reassemble it at consumption time."""
+        from ..ops.embedding import check_id_dtype
+        check_id_dtype(idx.dtype, None, "PS speculative pull")
         hm = self.config.health_monitor
         if hm is not None:
             hm.observe_ids(tid, idx)     # hot-key skew (worker thread)

@@ -289,7 +289,11 @@ class HealthMonitor:
             return
         t0 = time.perf_counter()
         import jax
-        host = jax.device_get(h)
+        # an attached RangeRecorder rides the same aux pytree under
+        # "ranges" (2 scalars per float node): that is ITS fetch, not
+        # this monitor's — pulling it here would double the transfer
+        host = jax.device_get({k: v for k, v in h.items()
+                               if k != "ranges"})
         self._sample(sub, host, step, runtime)
         self.sample_wall_ms += (time.perf_counter() - t0) * 1000.0
 
@@ -307,7 +311,10 @@ class HealthMonitor:
             return
         t0 = time.perf_counter()
         import jax
-        host = jax.device_get(health_stacked)
+        # "ranges" is the RangeRecorder's fetch, not this monitor's
+        # (see after_step)
+        host = jax.device_get({k: v for k, v in health_stacked.items()
+                               if k != "ranges"})
         for i, k in enumerate(sampled):
             row = {"layers": {n: {kk: vv[k - 1] for kk, vv in m.items()}
                               for n, m in host.get("layers", {}).items()}}

@@ -1,0 +1,660 @@
+"""Numerics & precision verifier (analysis/numerics.py, HT8xx) + the
+measured-range harness (analysis/rangecheck.py).
+
+Acceptance pins (ISSUE 14): every injected-bug fixture trips its HT8xx
+code with user file:line provenance and is silenced by an
+``# ht-ok: HT8xx`` waiver on that line; the whole zoo is clean under
+the numerics CLI gate; a rangecheck round-trip on >= 2 zoo models
+reports every measured per-op range inside its static interval; the
+bf16 collective-pipeline boundary tolerance is derivable from the
+verifier's HT805 interval math and covered by the runtime's declared
+rtol (fp16 widening trips without a retune).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import initializers as init
+from hetu_tpu.analysis import Report, analyze
+from hetu_tpu.analysis.numerics import (
+    accum_error_bound, boundary_error_bound, check_zoo, dtype_max,
+    exact_int_limit, numerics_pass, prec_class, stable_keys)
+from hetu_tpu.analysis.rangecheck import (
+    RangeDB, RangeRecorder, rangecheck_model, soundness_pass)
+from hetu_tpu.analysis.shapes import shape_pass
+from hetu_tpu.graph.autodiff import find_topo_sort
+
+THIS_FILE = os.path.abspath(__file__)
+
+
+def run_pass(eval_nodes, feed_shapes=None, config=None):
+    topo = find_topo_sort(list(eval_nodes))
+    dtypes = {}
+    shapes = shape_pass(topo, Report(), feed_shapes=feed_shapes,
+                        dtypes_out=dtypes)
+    report = Report()
+    ranges = numerics_pass(topo, report, shapes=shapes, dtypes=dtypes,
+                           config=config)
+    return report, ranges, topo
+
+
+def codes(report):
+    return {f.code for f in report.findings}
+
+
+def assert_provenance(finding):
+    """Every fixture finding must carry this test file's line."""
+    assert finding.where is not None, finding
+    path, _, line = finding.where.rpartition(":")
+    assert os.path.abspath(path) == THIS_FILE, finding.where
+    assert int(line) > 0
+
+
+# ---------------------------------------------------------------------------
+# HT801 — overflow-prone op in low precision
+# ---------------------------------------------------------------------------
+
+def _ht801_graph(waived=False):
+    import jax.numpy as jnp
+    x = init.random_uniform((4, 4), -30.0, 30.0, "x801")
+    h = ht.cast_op(x, jnp.float16)
+    if waived:
+        y = ht.exp_op(h)  # ht-ok: HT801 fixture waiver
+    else:
+        y = ht.exp_op(h)
+    return [ht.reduce_mean_op(y, [0, 1])]
+
+
+def test_ht801_unshifted_exp_in_fp16():
+    report, _, _ = run_pass(_ht801_graph())
+    hits = [f for f in report.findings if f.code == "HT801"]
+    assert hits and hits[0].severity == "error"
+    assert "float16" in hits[0].message
+    assert_provenance(hits[0])
+
+
+def test_ht801_waived_on_construction_line():
+    report, _, _ = run_pass(_ht801_graph(waived=True))
+    assert "HT801" not in codes(report)
+
+
+def test_ht801_fp32_to_fp16_downcast_overflow():
+    # the interval survives the cast; exceeding the TARGET dtype's max
+    # is overflow CREATED by the cast (each input is judged against
+    # its own precision, so this must not read as propagated-through)
+    import jax.numpy as jnp
+    x = init.random_uniform((4,), -1e6, 1e6, "x801d",
+                            trainable=False)
+    y = ht.cast_op(x, jnp.float16)
+    report, _, _ = run_pass([y])
+    hits = [f for f in report.findings if f.code == "HT801"]
+    assert hits and hits[0].severity == "error"
+    assert "CastOp" in hits[0].message
+
+
+def test_ht801_fp32_shifted_exp_clean():
+    # exp of a bounded negative operand (the erf-gradient idiom): clean
+    x = init.random_uniform((4, 4), -3.0, 3.0, "x801c")
+    y = ht.exp_op(ht.opposite_op(ht.mul_op(x, x)))
+    report, ranges, topo = run_pass([ht.reduce_mean_op(y, [0, 1])])
+    assert "HT801" not in codes(report)
+    rng = ranges[topo[-1]]
+    assert rng is not None and rng[1] <= 1.0 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# HT802 — low-precision accumulation
+# ---------------------------------------------------------------------------
+
+def test_ht802_bf16_matmul_accumulation():
+    import jax.numpy as jnp
+    x = ht.Variable("x802", trainable=False)
+    w = init.random_normal((1024, 16), name="w802")
+    y = ht.matmul_op(ht.cast_op(x, jnp.bfloat16),
+                     ht.cast_op(w, jnp.bfloat16))
+    report, _, _ = run_pass([y], feed_shapes={
+        "x802": ((8, 1024), np.float32)})
+    hits = [f for f in report.findings if f.code == "HT802"]
+    assert hits, report
+    assert "1024" in hits[0].message
+    assert "preferred_element_type" in hits[0].message
+    assert_provenance(hits[0])
+    # the same contraction in fp32 is fine
+    y32 = ht.matmul_op(ht.Variable("x802b", trainable=False), w)
+    rep32, _, _ = run_pass([y32], feed_shapes={
+        "x802b": ((8, 1024), np.float32)})
+    assert "HT802" not in codes(rep32)
+    assert accum_error_bound(jnp.bfloat16, 1024) > \
+        accum_error_bound(jnp.float32, 1024)
+
+
+def test_ht802_mixed_precision_session_uses_compute_dtype():
+    # Executor(dtype="bfloat16") casts the whole forward to bf16: the
+    # verifier must analyze at the session's EFFECTIVE precision, not
+    # the declared fp32 the graph was built with
+    import jax.numpy as jnp
+
+    class _Bf16Config:
+        dtype = jnp.bfloat16
+        pipeline_mode = None
+        pp_options = None
+
+    x = ht.Variable("x802m", trainable=False)
+    w = init.random_normal((4096, 16), name="w802m")
+    y = ht.matmul_op(x, w)          # no explicit casts anywhere
+    feeds = {"x802m": ((8, 4096), np.float32)}
+    report, _, _ = run_pass([y], feed_shapes=feeds,
+                            config=_Bf16Config())
+    assert any(f.code == "HT802" for f in report.findings), report
+    plain, _, _ = run_pass([y], feed_shapes=feeds)
+    assert "HT802" not in codes(plain)
+
+
+# ---------------------------------------------------------------------------
+# HT803 — integer-exactness loss on the id paths
+# ---------------------------------------------------------------------------
+
+def test_ht803_float_ids_past_2_24_rows():
+    tbl = init.random_normal(((1 << 24) + 2, 4), name="tbl803")
+    ids = ht.Variable("ids803", trainable=False)
+    look = ht.embedding_lookup_op(tbl, ids)
+    report, _, _ = run_pass(
+        [look], feed_shapes={"ids803": ((8,), np.float32)})
+    hits = [f for f in report.findings if f.code == "HT803"]
+    assert hits and hits[0].severity == "error"
+    assert_provenance(hits[0])
+
+
+def test_ht803_id_dtype_narrower_than_table():
+    tbl = init.random_normal(((1 << 31) + 2, 1), name="tbl803b")
+    ids = ht.Variable("ids803b", trainable=False)
+    look = ht.embedding_lookup_op(tbl, ids)
+    report, _, _ = run_pass(
+        [look], feed_shapes={"ids803b": ((8,), np.int32)})
+    hits = [f for f in report.findings if f.code == "HT803"]
+    assert hits and hits[0].severity == "error"
+    assert "int32" in hits[0].message
+    # int64 ids can address the table, but with jax x64 off the
+    # in-graph gather canonicalizes them to int32: no ERROR, yet the
+    # advisory warn names the x64/PS-host-path remediation
+    ids64 = ht.Variable("ids803c", trainable=False)
+    rep64, _, _ = run_pass(
+        [ht.embedding_lookup_op(tbl, ids64)],
+        feed_shapes={"ids803c": ((8,), np.int64)})
+    assert not [f for f in rep64.findings
+                if f.code == "HT803" and f.severity == "error"]
+    assert any(f.code == "HT803" and "x64" in f.message
+               for f in rep64.findings)
+
+
+def test_ht803_runtime_twin_rejects_float_ids():
+    from hetu_tpu.ops.embedding import check_id_dtype
+    with pytest.raises(TypeError, match="HT803"):
+        check_id_dtype(np.float32, None, "unit")
+    with pytest.raises(ValueError, match="HT803"):
+        check_id_dtype(np.int32, (1 << 31) + 2, "unit")
+    check_id_dtype(np.int64, (1 << 31) + 2, "unit")   # fits
+    check_id_dtype(np.int32, 1000, "unit")            # fits
+    assert exact_int_limit(np.float32) == 1 << 24
+
+
+def test_dataloader_preserves_integer_ids():
+    ids = np.arange(40, dtype=np.int64).reshape(10, 4)
+    dl = ht.Dataloader(ids, 2)
+    assert dl.raw_data.dtype == np.int32      # fits int32 -> canonical
+    big = ids + (1 << 40)
+    assert ht.Dataloader(big, 2).raw_data.dtype == np.int64
+    floats = np.ones((10, 4), np.float64)
+    assert ht.Dataloader(floats, 2).raw_data.dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# HT804 — unguarded zero-crossing domains
+# ---------------------------------------------------------------------------
+
+def test_ht804_log_of_zero_crossing_interval():
+    x = init.random_uniform((4,), -1.0, 1.0, "x804",
+                            trainable=False)
+    y = ht.log_op(x)
+    report, _, _ = run_pass([y])
+    hits = [f for f in report.findings if f.code == "HT804"]
+    assert hits, report
+    assert_provenance(hits[0])
+
+
+def test_ht804_eps_guard_recognized():
+    # x*x + eps excludes zero: interval arithmetic IS the guard check
+    x = init.random_uniform((4,), -1.0, 1.0, "x804b",
+                            trainable=False)
+    safe = ht.log_op(ht.addbyconst_op(ht.mul_op(x, x), 1e-6))
+    rsafe = ht.rsqrt_op(ht.addbyconst_op(ht.mul_op(x, x), 1e-6))
+    report, _, _ = run_pass([safe, rsafe])
+    assert "HT804" not in codes(report)
+
+
+def test_ht804_div_by_zero_crossing_denominator():
+    x = init.random_uniform((4,), -1.0, 1.0, "x804c",
+                            trainable=False)
+    num = init.ones((4,), name="num804", trainable=False)
+    report, _, _ = run_pass([ht.div_op(num, x)])
+    assert "HT804" in codes(report)
+    # clip-guarded twin is clean
+    report2, _, _ = run_pass(
+        [ht.div_op(num, ht.clip_op(x, 1e-6, None))])
+    assert "HT804" not in codes(report2)
+
+
+def test_ht804_log_sigmoid_saturation():
+    # finite-precision sigmoid rounds to exactly 0.0 for very negative
+    # operands: the derived interval must stay closed at 0 so the
+    # downstream log is flagged (a float64 lower bound like 1e-87
+    # would wrongly read as a guard)
+    x = init.random_uniform((4,), -200.0, -10.0, "x804s",
+                            trainable=False)
+    report, _, _ = run_pass([ht.log_op(ht.sigmoid_op(x))])
+    assert "HT804" in codes(report)
+
+
+def test_ht804_zero_eps_norms_all_flagged():
+    x = ht.Variable("x804n", trainable=False)
+    scale = init.ones((8,), name="s804n")
+    bias = init.zeros((8,), name="b804n")
+    ln = ht.layer_normalization_op(x, scale, bias, eps=0.0)
+    inorm = ht.instance_normalization2d_op(
+        ht.Variable("x804i", trainable=False), eps=0.0)
+    report, _, _ = run_pass(
+        [ht.reduce_mean_op(ln, [0, 1]), ht.reduce_mean_op(inorm, [0, 1])],
+        feed_shapes={"x804n": ((4, 8), np.float32),
+                     "x804i": ((2, 3, 4, 4), np.float32)})
+    hits = [f for f in report.findings if f.code == "HT804"]
+    assert len(hits) == 2, report.to_text()
+
+
+def test_losses_make_no_claim_for_off_simplex_labels():
+    # labels outside [0, 1] take BCE/CE negative: the transfer must
+    # return no bound rather than an unsound [0, hi] (a real run would
+    # otherwise trip the HT810 soundness gate on correct code)
+    from hetu_tpu.ops.losses import BinaryCrossEntropyOp
+    pred = ht.Variable("p_os", trainable=False)
+    bce = BinaryCrossEntropyOp(pred, pred)
+    assert bce.infer_range([(0.1, 0.9), (0.0, 2.0)]) is None
+    assert bce.infer_range([(0.1, 0.9), (0.0, 1.0)])[0] == 0.0
+
+
+def test_ht804_bad_optimizer_eps():
+    x = ht.Variable("x804d", trainable=False)
+    w = init.random_normal((6, 2), name="w804d")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    train = ht.optim.AdamOptimizer(1e-3, epsilon=0.0).minimize(loss)
+    report, _, _ = run_pass([loss, train], feed_shapes={
+        "x804d": ((4, 6), np.float32)})
+    assert any(f.code == "HT804" and "eps" in f.message
+               for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# HT805 — low-precision cross-replica/pipeline boundary
+# ---------------------------------------------------------------------------
+
+class _FakeConfig:
+    dtype = None
+    pipeline_mode = "collective"
+
+    def __init__(self, boundary_dtype, boundary_rtol=None):
+        self.pp_options = {"boundary_dtype": boundary_dtype}
+        if boundary_rtol is not None:
+            self.pp_options["boundary_rtol"] = boundary_rtol
+
+
+def _tiny_train():
+    x = ht.Variable("x805", trainable=False)
+    w = init.random_normal((6, 2), name="w805")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    return [loss, train], {"x805": ((4, 6), np.float32)}
+
+
+def test_ht805_bf16_boundary_covered_by_declared_rtol():
+    from hetu_tpu.parallel.collective_pp import BOUNDARY_RTOL
+    # the PR 1 contract: one bf16 cast hop stays inside the tested
+    # rtol 5e-3 — this is the derivation the runtime tolerance pins
+    assert boundary_error_bound("bfloat16", hops=1) <= BOUNDARY_RTOL
+    nodes, feeds = _tiny_train()
+    report, _, _ = run_pass(nodes, feed_shapes=feeds,
+                            config=_FakeConfig("bf16"))
+    assert not [f for f in report.findings if f.code == "HT805"]
+
+
+def test_ht805_bf16_boundary_with_too_tight_rtol_trips():
+    nodes, feeds = _tiny_train()
+    report, _, _ = run_pass(nodes, feed_shapes=feeds,
+                            config=_FakeConfig("bf16",
+                                               boundary_rtol=1e-5))
+    hits = [f for f in report.findings if f.code == "HT805"]
+    assert hits and hits[0].severity == "error"
+
+
+def test_ht805_accepts_dtype_object_spellings():
+    # the runtime's _canon_boundary_dtype accepts dtype OBJECTS; the
+    # static check must not go blind on them
+    nodes, feeds = _tiny_train()
+    report, _, _ = run_pass(nodes, feed_shapes=feeds,
+                            config=_FakeConfig(np.float16))
+    assert any(f.code == "HT805" for f in report.findings)
+
+
+def test_ht805_fp16_boundary_requires_retune():
+    # widening the boundary to fp16 halves the exponent range: the
+    # verifier refuses to stay silent until someone retunes
+    nodes, feeds = _tiny_train()
+    report, _, _ = run_pass(nodes, feed_shapes=feeds,
+                            config=_FakeConfig("fp16"))
+    hits = [f for f in report.findings if f.code == "HT805"]
+    assert hits
+    assert any("65504" in f.message or "exponent" in f.message
+               for f in hits)
+    assert dtype_max("float16") == 65504.0
+
+
+# ---------------------------------------------------------------------------
+# HT806 — fp16 backward with no loss scale
+# ---------------------------------------------------------------------------
+
+class _Fp16Config:
+    import jax.numpy as _jnp
+    dtype = _jnp.float16
+    pipeline_mode = None
+    pp_options = None
+
+
+def test_ht806_fp16_training_without_loss_scale():
+    nodes, feeds = _tiny_train()
+    report, _, _ = run_pass(nodes, feed_shapes=feeds,
+                            config=_Fp16Config())
+    hits = [f for f in report.findings if f.code == "HT806"]
+    assert hits and "loss_scale" in hits[0].message
+
+
+def test_ht806_loss_scale_clears_it():
+    x = ht.Variable("x806", trainable=False)
+    w = init.random_normal((6, 2), name="w806")
+    loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+    train = ht.optim.SGDOptimizer(0.1, loss_scale=1024).minimize(loss)
+    report, _, _ = run_pass([loss, train], feed_shapes={
+        "x806": ((4, 6), np.float32)}, config=_Fp16Config())
+    assert "HT806" not in codes(report)
+
+
+def test_loss_scale_is_numerically_neutral():
+    # loss_scale scales the backward and unscales in the update: the
+    # fp32 training trajectory is (near-)identical
+    def build(scale):
+        x = ht.Variable("xls", trainable=False)
+        w = ht.Variable("wls", value=np.full((6, 2), 0.3, "f"))
+        loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+        opt = ht.optim.SGDOptimizer(0.1, loss_scale=scale)
+        return [loss, opt.minimize(loss)], x
+
+    feeds = np.random.RandomState(0).randn(4, 6).astype("f")
+    outs = []
+    for scale in (None, 512.0):
+        nodes, x = build(scale)
+        exe = ht.Executor(nodes)
+        for _ in range(3):
+            out = exe.run(feed_dict={x: feeds},
+                          convert_to_numpy_ret_vals=True)
+        outs.append(out[0])
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
+
+
+def test_loss_scale_sentinels_report_unscaled_grads():
+    # the health monitor's grad-norm sentinels must see reality, not
+    # the scaled backward (4096x-inflated norms poison every record)
+    def grad_norm(scale):
+        x = ht.Variable("xsn", trainable=False)
+        w = ht.Variable("wsn", value=np.full((6, 2), 0.3, "f"))
+        loss = ht.reduce_mean_op(ht.matmul_op(x, w), [0, 1])
+        opt = ht.optim.SGDOptimizer(0.1, loss_scale=scale)
+        exe = ht.Executor([loss, opt.minimize(loss)],
+                          health_options={"every_n": 1})
+        feeds = np.random.RandomState(0).randn(4, 6).astype("f")
+        exe.run(feed_dict={x: feeds})
+        mon = exe.config.health_monitor
+        return mon.records[-1]["grad_norm_total"]
+
+    plain, scaled = grad_norm(None), grad_norm(4096.0)
+    assert scaled == pytest.approx(plain, rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# HT807 — PRNG stream reuse
+# ---------------------------------------------------------------------------
+
+def test_ht807_shared_key_between_independent_dropouts():
+    x = ht.Variable("x807", value=np.ones((4, 4), "f"),
+                    trainable=False)
+    d1 = ht.dropout_op(x, 0.9)
+    d2 = ht.dropout_op(x, 0.9)
+    d2.rng_key = d1.id          # graph-surgery id collision
+    report, _, _ = run_pass(
+        [ht.reduce_mean_op(ht.add_op(d1, d2), [0, 1])])
+    hits = [f for f in report.findings if f.code == "HT807"]
+    assert hits and hits[0].severity == "error"
+    assert d1.name in hits[0].message and d2.name in hits[0].message
+
+
+def test_ht807_forward_grad_pair_is_not_reuse():
+    # a dropout and its gradient replay ONE mask by design: clean
+    x = ht.Variable("x807b", value=np.ones((4, 4), "f"),
+                    trainable=False)
+    w = init.random_normal((4, 2), name="w807b")
+    d = ht.dropout_op(x, 0.9)
+    loss = ht.reduce_mean_op(ht.matmul_op(d, w), [0, 1])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    report, _, _ = run_pass([loss, train])
+    assert "HT807" not in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# executor integration + zoo gate
+# ---------------------------------------------------------------------------
+
+def test_validate_error_rejects_fp16_overflow_graph():
+    from hetu_tpu.analysis import GraphValidationError
+    with pytest.raises(GraphValidationError, match="HT801"):
+        ht.Executor(_ht801_graph(), validate="error")
+
+
+def test_zoo_clean_under_numerics_gate():
+    reports = check_zoo()
+    assert len(reports) == 14
+    dirty = {n: [str(f) for f in r.findings]
+             for n, r in reports.items() if len(r)}
+    assert not dirty, dirty
+
+
+def test_analyze_includes_numerics_findings():
+    report = analyze(_ht801_graph())
+    assert "HT801" in codes(report)
+
+
+# ---------------------------------------------------------------------------
+# rangecheck: fused capture, soundness gate, measured-range DB
+# ---------------------------------------------------------------------------
+
+def _mlp_executor():
+    x = ht.Variable("xrc", trainable=False)
+    w1 = init.xavier_normal((6, 8), name="w1rc")
+    w2 = init.xavier_normal((8, 2), name="w2rc")
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(ht.matmul_op(h, w2), [0, 1])
+    train = ht.optim.SGDOptimizer(0.05).minimize(loss)
+    return ht.Executor([loss, train]), x
+
+
+def test_range_recorder_fused_capture():
+    exe, x = _mlp_executor()
+    rec = RangeRecorder(exe, every_n=1).attach()
+    rng = np.random.RandomState(1)
+    try:
+        for _ in range(3):
+            exe.run(feed_dict={x: rng.randn(4, 6).astype("f")})
+            rec.sample()
+    finally:
+        rec.detach()
+    assert rec.fetches == 3
+    assert rec.measured, "no ranges captured"
+    for name, (lo, hi) in rec.measured.items():
+        assert lo <= hi, name
+    keyed = rec.by_stable_key()
+    assert keyed and all(":" in k for k in keyed)
+    # detached executor runs without the capture
+    exe.run(feed_dict={x: rng.randn(4, 6).astype("f")})
+
+
+def test_range_recorder_block_path():
+    # the lax.scan block path stacks the capture [nsteps, ...]; the
+    # recorder reduces over the scan axis instead of silently
+    # measuring nothing
+    exe, x = _mlp_executor()
+    rec = RangeRecorder(exe, every_n=1).attach()
+    rng = np.random.RandomState(2)
+    feeds = [{x: rng.randn(4, 6).astype("f")} for _ in range(3)]
+    try:
+        exe.run_batches(feeds)
+        rec.sample()
+    finally:
+        rec.detach()
+    assert rec.fetches == 1 and rec.measured
+    for name, (lo, hi) in rec.measured.items():
+        assert np.isscalar(lo) or np.ndim(lo) == 0
+        assert lo <= hi, name
+
+
+def test_rangecheck_roundtrip_two_zoo_models(tmp_path):
+    # acceptance: every measured per-op range inside its static
+    # interval on >= 2 zoo models; DB persisted (conftest ships
+    # rangedb_*.json as a failure artifact)
+    db = RangeDB(str(tmp_path / "rangedb_roundtrip.json"))
+    for model in ("mlp", "wdl_adult"):
+        report, measured, checked = rangecheck_model(
+            model, steps=3, db=db)
+        assert measured, model
+        assert checked > 0, model
+        assert not report.errors, \
+            f"{model}: {[str(f) for f in report.errors]}"
+    db.save()
+    reloaded = RangeDB(db.path)
+    assert set(reloaded.data) == {"mlp", "wdl_adult"}
+    got = reloaded.get("mlp")
+    assert got and all(lo <= hi for lo, hi in got.values())
+
+
+def test_measured_db_tightens_reanalysis(tmp_path):
+    db = RangeDB(str(tmp_path / "rangedb_tighten.json"))
+    report, measured, _ = rangecheck_model("mlp", steps=3, db=db)
+    assert not report.errors
+    from hetu_tpu.analysis import zoo
+    eval_nodes, feed_shapes = zoo.build("mlp")
+    topo = find_topo_sort(list(eval_nodes))
+    dtypes = {}
+    shapes = shape_pass(topo, Report(), feed_shapes=feed_shapes,
+                        dtypes_out=dtypes)
+    plain = numerics_pass(topo, Report(), shapes=shapes, dtypes=dtypes)
+    tight = numerics_pass(topo, Report(), shapes=shapes, dtypes=dtypes,
+                          measured=db.get("mlp"))
+    known_plain = sum(1 for r in plain.values() if r is not None)
+    known_tight = sum(1 for r in tight.values() if r is not None)
+    assert known_tight >= known_plain
+    # at least one previously-unknown interval (the feed path) is now
+    # bounded by the measured run
+    gained = [n for n in topo
+              if plain.get(n) is None and tight.get(n) is not None]
+    assert gained, "measured DB tightened nothing"
+
+
+def test_interval_product_survives_half_bounded_operands():
+    # clip(x, None, 1) of an unknown operand is (-inf, 1]; its product
+    # with a zero-touching relu must not NaN out (0*inf := 0), and the
+    # unguarded div downstream must still fire HT804
+    x = ht.Variable("xiv", trainable=False)
+    r = init.random_uniform((4,), 0.0, 2.0, "riv", trainable=False)
+    clipped = ht.clip_op(x, None, 1.0)
+    prod = ht.mul_op(clipped, r)
+    num = init.ones((4,), name="niv", trainable=False)
+    report, ranges, topo = run_pass([ht.div_op(num, prod)])
+    rng = ranges[prod]
+    assert rng is not None and rng[0] == -float("inf") \
+        and rng[1] == 2.0, rng
+    assert "HT804" in codes(report)
+
+
+def test_soundness_gate_enforces_finite_side_of_half_bounded():
+    # a static [0, inf) must still reject a measured negative min, and
+    # a NaN measurement is always a violation
+    x = init.random_uniform((4,), 0.5, 2.0, "xhb", trainable=False)
+    y = ht.exp_op(x)
+    topo = find_topo_sort([y])
+    ranges = {n: None for n in topo}
+    ranges[y] = (1.0, float("inf"))
+    key_y = stable_keys(topo)[topo.index(y)]
+    rep, _ = soundness_pass(topo, ranges, {key_y: (-5.0, 100.0)})
+    assert any(f.code == "HT810" for f in rep.errors)
+    rep2, _ = soundness_pass(topo, ranges,
+                             {key_y: (float("nan"), 1.0)})
+    assert any(f.code == "HT810" for f in rep2.errors)
+    rep3, _ = soundness_pass(topo, ranges, {key_y: (1.5, 1e30)})
+    assert not rep3.errors
+
+
+def test_soundness_gate_flags_escaping_range():
+    x = init.random_uniform((4,), -1.0, 1.0, "xsg", trainable=False)
+    y = ht.tanh_op(x)
+    topo = find_topo_sort([y])
+    ranges = {n: None for n in topo}
+    ranges[y] = (-1.0, 1.0)
+    keys = stable_keys(topo)
+    key_y = keys[topo.index(y)]
+    report, checked = soundness_pass(topo, ranges,
+                                     {key_y: (-0.5, 3.0)})
+    assert checked == 1
+    assert any(f.code == "HT810" for f in report.errors)
+    ok_report, _ = soundness_pass(topo, ranges, {key_y: (-0.9, 0.9)})
+    assert not ok_report.errors
+
+
+def test_numerics_cli_and_rangecheck_cli(tmp_path):
+    from hetu_tpu.analysis.numerics import main as nmain
+    assert nmain(["mlp", "logreg"]) == 0
+    from hetu_tpu.analysis.rangecheck import main as rmain
+    db = str(tmp_path / "rangedb_cli.json")
+    assert rmain(["mlp", "--steps", "2", "--db", db]) == 0
+    data = json.load(open(db))
+    assert data["models"]["mlp"]
+
+
+# ---------------------------------------------------------------------------
+# graphboard overlay
+# ---------------------------------------------------------------------------
+
+def test_graphboard_range_overlay(tmp_path):
+    from hetu_tpu import graphboard
+    exe, x = _mlp_executor()
+    sub = exe.subexecutors["default"]
+    topo = sub.topo_order
+    dtypes = {}
+    shapes = shape_pass(topo, Report(),
+                        feed_shapes={x: ((4, 6), np.float32)},
+                        dtypes_out=dtypes)
+    ranges = numerics_pass(topo, Report(), shapes=shapes,
+                           dtypes=dtypes)
+    out = graphboard.render(exe, str(tmp_path / "board.html"),
+                            ranges=ranges, dtypes=dtypes)
+    html = open(out).read()
+    assert "∈ [" in html            # tooltip carries the interval
+    assert "fp32" in html           # propagated precision class shown
+    dot = open(str(tmp_path / "board.dot")).read()
+    assert "∈[" in dot and "fp32" in dot

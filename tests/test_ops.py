@@ -158,11 +158,23 @@ def test_layernorm():
 
 def test_embedding_lookup():
     table = rand(20, 8, seed=16)
+    idx = np.array([[1, 5], [3, 19]], dtype=np.int32)
+    emb = ht.Variable("emb", value=table)
+    i = ht.Variable("i", value=idx, dtype=np.int32)
+    (out,) = run_graph([ht.embedding_lookup_op(emb, i)])
+    np.testing.assert_allclose(out, table[idx], rtol=1e-5)
+
+
+def test_embedding_lookup_rejects_float_ids():
+    # HT803's runtime twin: float ids lose integer exactness past 2^24
+    # (the silent astype(int32) this repo used to do) — the lookup now
+    # refuses them at trace time
+    table = rand(20, 8, seed=16)
     idx = np.array([[1, 5], [3, 19]], dtype=np.float32)
     emb = ht.Variable("emb", value=table)
     i = ht.Variable("i", value=idx)
-    (out,) = run_graph([ht.embedding_lookup_op(emb, i)])
-    np.testing.assert_allclose(out, table[idx.astype(int)], rtol=1e-5)
+    with pytest.raises(Exception, match="HT803"):
+        run_graph([ht.embedding_lookup_op(emb, i)])
 
 
 def test_csrmm():
