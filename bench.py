@@ -381,9 +381,20 @@ def bench_mlp_cifar():
                               zip(dims[:-1], dims[1:]))
     blocks = _step_samples(lambda: exe.run_batches(block),
                            lambda out: out[-1][0].asnumpy(), 6)
+    # priced static lint beside the measured number (informational,
+    # regress.py never direction-compares them): estimated_ms_per_step
+    # is the HT9xx verifier's predicted per-step waste for this graph,
+    # ht9xx_findings its finding count — a reviewer sees prediction
+    # and measurement on one record
+    from hetu_tpu.analysis.efficiency import predict as _eff_predict
+    eff = _eff_predict([loss, train_op],
+                       feed_shapes={x: ((batch, 3072), np.float32),
+                                    y_: ((batch, 10), np.float32)})
     emit("mlp_cifar10_step_time", ms, "ms/step", MLP_BASELINE_MS / ms,
          best=best / steps * 1000, h2d_MBps=h2d_probe_mbps(),
          jit_compiles=_compiles() - c0,
+         estimated_ms_per_step=eff.predicted_waste_ms(),
+         ht9xx_findings=len(eff.report),
          **_pctl([b / kblock for b in blocks]),
          **mfu_fields(flops, med / steps))
 

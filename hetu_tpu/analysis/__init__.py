@@ -3,10 +3,10 @@ sessions.
 
 The define-then-run model hands us the *whole* program — graph,
 partition states, pipeline schedule, placement — before a single byte
-moves. This package runs six static passes over the topo-sorted graph
-between construction and first dispatch, each emitting structured
-:class:`~.findings.Finding` objects with stable codes and per-op user
-provenance:
+moves. This package runs its battery of static passes over the
+topo-sorted graph between construction and first dispatch, each
+emitting structured :class:`~.findings.Finding` objects with stable
+codes and per-op user provenance:
 
 1. **shapes** (HT1xx) — shape/dtype propagation through the existing
    ``Op.infer_shape`` protocol + dead-subgraph/unused-variable/
@@ -30,7 +30,14 @@ provenance:
    integer-exactness cliffs on the id paths, low-precision
    accumulation/boundary/underflow risks, PRNG stream reuse — with
    ``analysis/rangecheck.py`` as its measured-range dynamic twin
-   (soundness gate + persistent range DB that tightens re-analysis).
+   (soundness gate + persistent range DB that tightens re-analysis),
+7. **efficiency** (HT9xx) — CostDB-priced static performance lint:
+   recompile hazards, tile-padding waste, hot-path host syncs,
+   fragmented collectives, redundant reshards, cost-weighted dead
+   compute, untuned kernels, coverage-gap advisories — every finding
+   priced in predicted ms/step through the measured CostDB, with
+   ``analysis/perfcheck.py`` as its doctor-validated soundness twin
+   (measured bucket attribution gates every priced claim, HT910).
 
 Two codebase self-lints ride beside the graph passes: **jit_purity**
 (HTPxx — host impurity inside jit-traced bodies) and **concurrency**
@@ -63,6 +70,7 @@ from .deadlock import deadlock_pass
 from .memory import memory_pass, check_compiled
 from .overlap import overlap_pass, RunLoopAdvisor
 from .numerics import numerics_pass
+from .efficiency import efficiency_pass
 from .findings import suppressed
 
 __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
@@ -70,8 +78,8 @@ __all__ = ["Finding", "Report", "GraphValidationError", "collecting",
            "finish_preflight",
            "shape_pass", "lint_pass", "frozen_graph_pass",
            "sharding_pass", "deadlock_pass", "memory_pass",
-           "overlap_pass", "numerics_pass", "RunLoopAdvisor",
-           "check_compiled", "EXIT_PREFLIGHT"]
+           "overlap_pass", "numerics_pass", "efficiency_pass",
+           "RunLoopAdvisor", "check_compiled", "EXIT_PREFLIGHT"]
 
 # distinct exit code for "preflight found errors" (cf. the watchdog's
 # 117): the launcher refuses to spawn the fleet when it sees it
@@ -138,6 +146,12 @@ def analyze(eval_node_list, feed_shapes=None, config=None, schedule=None,
     _guard("memory", memory_pass, topo, shapes, report,
            budget=hbm_budget)
     _guard("overlap", overlap_pass, topo, report, config=config)
+    # priced performance lint (HT9xx): warn above the ms threshold,
+    # info below, never error — slow is advisory at launch time, the
+    # zoo CLI (python -m hetu_tpu.analysis.efficiency) owns the gate
+    _guard("efficiency", efficiency_pass, topo, report, shapes=shapes,
+           dtypes=dtypes, config=config, eval_nodes=eval_node_list,
+           extra_roots=extra_roots)
     # PS-backed graphs will drive the native wire protocol: cross-check
     # the C++/ctypes contract (HT701/HT702) before the first RPC. The
     # parse is cached per process, so repeated preflights cost a dict

@@ -8,10 +8,12 @@ the codebase self-lint in the same invocation.
 
 ``--all`` is the aggregate driver: zoo preflight + jit-purity +
 concurrency + protocol (wire contract and consistency model checking)
-in one invocation with a single merged report and exit code — the CI
-``analysis`` job, which uploads the merged JSON (``--out``) as its
-artifact. Per-pass gates keep their own semantics (zoo/jit-purity gate
-on errors; concurrency/protocol gate on ANY unsuppressed finding).
++ numerics + efficiency in one invocation with a single merged report
+and exit code — the CI ``analysis`` job, which uploads the merged
+JSON (``--out``) as its artifact. Per-pass gates keep their own
+semantics (zoo/jit-purity gate on errors; concurrency/protocol/
+numerics gate on ANY unsuppressed finding; efficiency on unsuppressed
+warn/error findings).
 """
 from __future__ import annotations
 
@@ -135,6 +137,19 @@ def _main_all(names, args):
     num_total = sum(len(r) for r in num.values())
     gates["numerics"] = 1 if num_total else 0
 
+    # efficiency verifier (HT9xx): CostDB-priced performance lint,
+    # gating on unsuppressed warn/error findings (info pricings and
+    # HT908 coverage advisories print but never gate) — same
+    # semantics as python -m hetu_tpu.analysis.efficiency
+    from .efficiency import check_zoo as eff_zoo
+    eff = eff_zoo(names)
+    sections["efficiency"] = {n: r.to_dict() for n, r in eff.items()}
+    eff_gating = sum(
+        1 for r in eff.values() for f in r.report.findings
+        if f.severity in ("warn", "error"))
+    eff_total = sum(len(r.report) for r in eff.values())
+    gates["efficiency"] = 1 if eff_gating else 0
+
     rc = max(gates.values())
     merged = {"ok": rc == 0, "gates": gates, "sections": sections}
     if args.json:
@@ -146,7 +161,9 @@ def _main_all(names, args):
               + f"; jit-purity {len(jit.errors)} error(s); "
               f"concurrency {len(conc)} finding(s); protocol "
               f"{len(proto)} finding(s), {stats['states']} model "
-              f"states explored; numerics {num_total} finding(s)")
+              f"states explored; numerics {num_total} finding(s); "
+              f"efficiency {eff_total} finding(s) "
+              f"({eff_gating} gating)")
         for name, rep in models.items():
             for f in rep.errors:
                 print(f"   zoo/{name}: {f}")
@@ -156,6 +173,9 @@ def _main_all(names, args):
         for name, rep in num.items():
             for f in rep.findings:
                 print(f"   numerics/{name}: {f}")
+        for name, res in eff.items():
+            for f in res.findings:
+                print(f"   efficiency/{name}: {f}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f, indent=2)

@@ -57,6 +57,12 @@ __all__ = ["Executor", "HetuConfig", "SubExecutor", "gradients",
 
 _jax_distributed_initialized = False
 
+# distinct compiled feed-shape signatures in one subexecutor before the
+# HT901 recompile advisory fires (analysis/efficiency.py): past any
+# legitimate warmup (train + eval shapes, a block variant or two),
+# clearly shape churn by then
+_RECOMPILE_ADVISORY_COMPILES = 8
+
 
 def maybe_init_distributed():
     """Join the multi-host JAX job when the heturun launcher set the
@@ -172,6 +178,15 @@ class HetuConfig:
             if "pp_options" in overrides:
                 pp_options = {**(pp_options or {}),
                               **overrides["pp_options"]}
+            if "overlap_options" in overrides:
+                # plan-derived knob defaults (dp bucket_bytes): the
+                # user's explicit overlap_options keys win
+                planned = overrides["overlap_options"]
+                if isinstance(overlap_options, _ingest_engine.OverlapOptions):
+                    pass        # fully resolved by the caller: keep it
+                else:
+                    overlap_options = {**planned,
+                                       **(overlap_options or {})}
             # dp: realized in-process as a dp mesh over the first dp
             # local devices (batch shards on dp, gradients reduce
             # implicitly in the SPMD program — the test_parallel dp
@@ -524,6 +539,7 @@ class SubExecutor:
         self.param_nodes = [n for n in self.param_nodes
                             if not (n in ps_params and n.is_embed)]
         self.compiled = {}
+        self._recompile_advised = False
         self.step_count = 0
         self.batch_num = None
         for dl in self.dataloader_ops:
@@ -643,17 +659,9 @@ class SubExecutor:
         allreduce_defer = frozenset()
         if getattr(config, "overlap", None) is not None and \
                 config.overlap.bucket_bytes:
-            consumers = {}
-            for op in topo:
-                for inp in op.inputs:
-                    consumers.setdefault(inp, []).append(op)
-            eval_set = set(eval_nodes)
-            allreduce_defer = frozenset(
-                inp for op in self.optimizer_ops for inp in op.inputs
-                if isinstance(inp, AllReduceCommunicateOp)
-                and inp not in eval_set
-                and all(c in optimizer_set
-                        for c in consumers.get(inp, ())))
+            from .ops.comm import optimizer_allreduce_ops
+            allreduce_defer = optimizer_allreduce_ops(
+                topo, self.optimizer_ops, eval_nodes)
         self._allreduce_defer_n = len(allreduce_defer)
         # training health sentinels (telemetry/health.py): when the
         # monitor is on, OptimizerOp.compute captures per-layer grad
@@ -863,6 +871,19 @@ class SubExecutor:
         tel.inc("jit_compiles")
         tel.observe("jit_compile_ms", (t1 - t0) / 1e6)
 
+    def _note_compile(self):
+        """HT901 runtime half (analysis/efficiency.py): when a session
+        keeps compiling new feed-shape signatures — the recompile-storm
+        pattern serving solved with mandatory bucketing — advise once,
+        with the accumulated shape keys as evidence. Cost while quiet:
+        one ``len()`` check per *compile* (never per step)."""
+        if self._recompile_advised or \
+                len(self.compiled) < _RECOMPILE_ADVISORY_COMPILES:
+            return
+        self._recompile_advised = True
+        from .analysis.efficiency import advise_recompiles
+        advise_recompiles(self)
+
     def _build_block(self, nsteps):
         """``nsteps`` training steps as ONE compiled program: a lax.scan
         over stacked feeds. Per-invocation dispatch/transfer overhead —
@@ -966,6 +987,7 @@ class SubExecutor:
                     (executor.params, executor.state, executor.opt_state,
                      feeds, lrs, np.int32(self.step_count),
                      executor.base_rng))
+            self._note_compile()
         fn = self.compiled[key]
         with self.config.telemetry.span("block_dispatch", steps=nsteps,
                                         subgraph=self.name):
@@ -1085,6 +1107,7 @@ class SubExecutor:
                 self._ensure_state(executor)
                 self.compiled[key] = self._compile_step(
                     self.trace_args(executor, feed_map))
+            self._note_compile()
         fn = self.compiled[key]
 
         with self.config.telemetry.span("device_dispatch",

@@ -31,6 +31,17 @@ _PKG_DIR = os.path.dirname(
 # distinct co_filenames on any stack is tiny)
 _NORM_CACHE = {}
 
+# op/initializer/optimizer plumbing never counts as a construction
+# site: when a graph is built entirely inside the package (the zoo
+# registry, spliced comm subgraphs), the provenance falls back to the
+# first frame outside these directories — the models/ (or parallel/)
+# line that composed the op — so findings still carry a real file:line
+# a reviewer can annotate with `# ht-ok: <CODE>` waivers
+_INTERNAL_PREFIXES = tuple(
+    os.path.join(_PKG_DIR, p) for p in ("graph", "ops")) + tuple(
+    os.path.join(_PKG_DIR, p) for p in ("initializers.py",
+                                        "optimizer.py"))
+
 
 def _norm(fn):
     n = _NORM_CACHE.get(fn)
@@ -42,21 +53,30 @@ def _norm(fn):
 
 
 def _construction_site():
-    """(filename, lineno) of the nearest caller outside hetu_tpu — the
-    user line that built this op. The analysis passes attach it to
-    findings so a shape mismatch ten layers deep reports the model
-    code, not the framework. One cheap frame walk per op; None when
-    construction never left the package (internal graphs)."""
+    """((filename, lineno) or None, (filename, lineno) or None) — the
+    nearest caller outside hetu_tpu (the *user* line that built this
+    op: findings report it so a shape mismatch ten layers deep names
+    the model code, not the framework) and the nearest frame outside
+    the op/initializer plumbing (the line that *composed* the op —
+    a ``hetu_tpu/models/`` line when the package built its own graph,
+    where ``# ht-ok`` waiver comments anchor). One cheap frame walk
+    per op; either element may be None."""
     try:
         f = sys._getframe(1)
     except Exception:       # noqa: BLE001 — provenance is best effort
-        return None
+        return None, None
+    composed = None
     while f is not None:
         fn = _norm(f.f_code.co_filename)
-        if not fn.startswith(_PKG_DIR) and not fn.startswith("<frozen"):
-            return (fn, f.f_lineno)
+        if not fn.startswith(_PKG_DIR) and not fn.startswith("<frozen") \
+                and not fn.endswith(os.sep + "runpy.py"):
+            # runpy is `python -m`'s launcher, not a construction site
+            return (fn, f.f_lineno), composed
+        if composed is None and fn.startswith(_PKG_DIR) \
+                and not fn.startswith(_INTERNAL_PREFIXES):
+            composed = (fn, f.f_lineno)
         f = f.f_back
-    return None
+    return composed, composed
 
 
 def reset_node_ids():
@@ -122,7 +142,11 @@ class Op:
                         else op_type.__name__)
         self.id = G_NODE_ID
         G_NODE_ID += 1
-        self.defined_at = _construction_site()
+        # defined_at: the user line (analysis findings report it);
+        # composed_at: the in-package model line that composed the op
+        # (None when they coincide or no such frame exists) — waiver
+        # comments on either line suppress a finding
+        self.defined_at, self.composed_at = _construction_site()
         self.name = self.op_type + str(self.id)
         self.desc = self.name + "(" + ", ".join(
             inp.name for inp in self.inputs) + ")"

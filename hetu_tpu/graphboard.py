@@ -32,6 +32,14 @@ artifact.
 derived value intervals: each covered node's sublabel gains
 ``∈[lo, hi]`` plus its precision class from the node dtype, so an
 HT801/HT804 report can be read against the graph it indicts.
+
+``waste=`` (an ``analysis.efficiency.EfficiencyResult``, from
+``efficiency.predict(...)``) overlays the priced performance lint:
+node fill heats by *predicted* per-op ms (the CostDB/FLOPs cost
+model — no run required, unlike ``costs=``) and HT9xx-diagnosed
+nodes get the findings treatment (severity border + codes + the
+priced ``estimated_ms_per_step`` in the tooltip). Shorthand for
+``costs=result.op_ms, findings=result.report``.
 """
 from __future__ import annotations
 
@@ -93,6 +101,22 @@ _FINDING_STROKE = {"error": "#cc1f1f", "warn": "#e08a00",
 _SEV_RANK = {"error": 0, "warn": 1, "info": 2}
 
 
+def _resolve_waste(waste, costs, findings):
+    """Fold a ``waste=`` overlay (an ``EfficiencyResult`` or anything
+    with ``op_ms``/``report``) into the costs + findings inputs: the
+    predicted per-op ms map drives the heat, the HT9xx report drives
+    the borders/codes. Explicit ``costs=``/``findings=`` win."""
+    if waste is None:
+        return costs, findings
+    op_ms = getattr(waste, "op_ms", None)
+    report = getattr(waste, "report", None)
+    if costs is None and op_ms:
+        costs = dict(op_ms)
+    if findings is None and report is not None:
+        findings = report
+    return costs, findings
+
+
 def _finding_map(findings):
     """Normalize the ``findings=`` overlay input to
     ``{op_name: (severity, [codes...], [messages...])}``. Accepts an
@@ -112,6 +136,9 @@ def _finding_map(findings):
         sev = getattr(f, "severity", "warn")
         code = getattr(f, "code", "")
         msg = getattr(f, "message", "")
+        ms = (getattr(f, "data", None) or {}).get("estimated_ms_per_step")
+        if ms is not None:
+            msg = f"{msg} [{ms:g} ms/step predicted]"
         cur = out.get(node)
         if cur is None:
             out[node] = (sev, [code] if code else [], [msg] if msg else [])
@@ -192,12 +219,14 @@ def _annotations(executor, topo):
 
 
 def to_dot(executor, costs=None, findings=None, ranges=None,
-           dtypes=None):
+           dtypes=None, waste=None):
     """Graphviz source for the session graph (reference
     graph2fig.py:11-23 builds the same node/edge list); ``costs``
-    overlays cost heat, ``findings`` the preflight diagnostics and
-    ``ranges`` (+ ``dtypes``) the numerics intervals exactly like
+    overlays cost heat, ``findings`` the preflight diagnostics,
+    ``ranges`` (+ ``dtypes``) the numerics intervals and ``waste``
+    (an ``EfficiencyResult``) the priced HT9xx lint, exactly like
     ``render``."""
+    costs, findings = _resolve_waste(waste, costs, findings)
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap, dbinfo = _resolve_costs(costs, topo)
@@ -279,7 +308,7 @@ def _layout(topo):
 
 
 def render(executor, path="graphboard.html", costs=None, findings=None,
-           ranges=None, dtypes=None):
+           ranges=None, dtypes=None, waste=None):
     """Write a standalone HTML/SVG of the graph (plus .dot beside it);
     returns the html path. ``costs`` (``profile_ops`` output or a
     {name: ms} dict) switches node fill to per-op cost heat;
@@ -287,7 +316,10 @@ def render(executor, path="graphboard.html", costs=None, findings=None,
     severity-colored border and their HT codes; ``ranges`` (the
     numerics pass output) joins each node's derived interval to its
     sublabel/tooltip, with ``dtypes`` (the shape pass's propagated
-    map) supplying the precision class."""
+    map) supplying the precision class; ``waste`` (an
+    ``efficiency.predict`` result) heats by predicted per-op ms with
+    the HT9xx codes as findings."""
+    costs, findings = _resolve_waste(waste, costs, findings)
     topo = _topo(executor)
     ann = _annotations(executor, topo)
     cmap, dbinfo = _resolve_costs(costs, topo)
@@ -383,6 +415,7 @@ def render(executor, path="graphboard.html", costs=None, findings=None,
     with open(path, "w") as f:
         f.write(page)
     with open(os.path.splitext(path)[0] + ".dot", "w") as f:
+        # waste already folded into costs/findings above
         f.write(to_dot(executor, costs=costs, findings=findings,
                        ranges=ranges, dtypes=dtypes))
     return path
@@ -411,7 +444,7 @@ class ServerHandle(str):
 
 
 def show(executor, path="graphboard.html", port=None, costs=None,
-         findings=None, ranges=None, dtypes=None):
+         findings=None, ranges=None, dtypes=None, waste=None):
     """Render and (optionally) serve like the reference's graphboard
     (graph2fig.py:11-33). ``port=None`` skips the server; with a port
     the returned URL is a :class:`ServerHandle` whose ``shutdown()``
@@ -421,9 +454,10 @@ def show(executor, path="graphboard.html", port=None, costs=None,
     ``analysis.Report``, e.g. ``executor.config.analysis_report``)
     overlays preflight diagnostics; ``ranges`` (the numerics pass
     output) + ``dtypes`` overlay derived intervals + precision
-    classes."""
+    classes; ``waste`` (``efficiency.predict`` output) overlays
+    predicted-ms heat + HT9xx codes."""
     out = render(executor, path, costs=costs, findings=findings,
-                 ranges=ranges, dtypes=dtypes)
+                 ranges=ranges, dtypes=dtypes, waste=waste)
     if port is None:
         return out
     import functools

@@ -37,7 +37,7 @@ def conv2d(x, in_channel, out_channel, kernel=3, stride=1, padding=1,
     weight = init.random_normal(
         shape=(out_channel, in_channel, kernel, kernel), stddev=0.1,
         name=name + "_weight")
-    return conv2d_op(x, weight, stride=stride, padding=padding)
+    return conv2d_op(x, weight, stride=stride, padding=padding)  # ht-ok: HT902 reference channel widths (AlexNet/CNN 64-cout stages) pinned for parity; lane padding prices <1 ms/step at zoo batch. NOTE: composed_at anchors here, so this waives conv tiling for EVERY model built through this helper — a new model with genuinely wasteful widths must use conv2d_op directly (its own call line re-arms the lint)
 
 
 def conv_bn_relu(x, in_channel, out_channel, name):
@@ -48,7 +48,7 @@ def conv_bn_relu(x, in_channel, out_channel, name):
         shape=(1, out_channel, 1, 1), stddev=0.1, name=name + "_scale")
     bn_bias = init.random_normal(
         shape=(1, out_channel, 1, 1), stddev=0.1, name=name + "_bias")
-    x = conv2d_op(x, weight, padding=1, stride=1)
+    x = conv2d_op(x, weight, padding=1, stride=1)  # ht-ok: HT902 reference VGG 64-channel blocks pinned for parity; lane padding prices ~1.7 ms/step at zoo batch (same justification and helper-wide breadth caveat as conv2d above)
     x = batch_normalization_op(x, bn_scale, bn_bias)
     return relu_op(x)
 

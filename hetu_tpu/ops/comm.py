@@ -121,6 +121,27 @@ class GroupAllReduceCommunicateOp(AllReduceCommunicateOp):
             return val          # axis not bound in this trace: marker
 
 
+def optimizer_allreduce_ops(topo, optimizer_ops, eval_nodes):
+    """The gradient-allreduce comm ops eligible for bucketing: optimizer
+    inputs that are AllReduce comm ops, not fetched by the session
+    themselves, and consumed by nothing but optimizers (a second
+    consumer needs the per-grad value in place). One definition shared
+    by the executor's trace-build defer set and the HT904 fragmented-
+    collective lint — the lint must price exactly the set
+    ``bucket_bytes`` would bucket."""
+    optimizer_set = set(optimizer_ops)
+    consumers = {}
+    for op in topo:
+        for inp in op.inputs:
+            consumers.setdefault(inp, []).append(op)
+    eval_set = set(eval_nodes)
+    return frozenset(
+        inp for op in optimizer_set for inp in op.inputs
+        if isinstance(inp, AllReduceCommunicateOp)
+        and inp not in eval_set
+        and all(c in optimizer_set for c in consumers.get(inp, ())))
+
+
 def settle_deferred_allreduce(inputs, input_vals, ectx):
     """Bucketed gradient allreduce (PyTorch-DDP-style, Li et al. VLDB
     2020): reduce the OptimizerOp's deferred gradients in size-targeted

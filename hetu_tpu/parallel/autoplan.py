@@ -331,7 +331,13 @@ def flops_of(node, shapes):
 
     if kind == "MatMulOp" and len(ins) == 2 and ins[1]:
         if ins[0] and out:
-            return 2.0 * prod(out) * int(ins[0][-1])
+            # contraction dim honors the transpose flag: a gradient
+            # matmul (trans_A=True) contracts over ins[0][-2], and
+            # reading [-1] there inflates its FLOPs by the weight dim
+            k = int(ins[0][-2]
+                    if getattr(node, "matmul_attr_trans_A", False)
+                    else ins[0][-1])
+            return 2.0 * prod(out) * k
         # activation shape unknown (construction-time planning):
         # assume the default batch over the known weight
         return 2.0 * _DEFAULT_BATCH * prod(ins[1])
@@ -725,6 +731,8 @@ def choose_plan(eval_nodes, nworld=None, rules=None, db=None,
     if db is None:
         db = CostDB()
     info = graph_costs(eval_nodes, db=db, feed_shapes=feed_shapes)
+    info["db"] = db             # apply_plan derives dp knob defaults
+    # (bucket_bytes) from the same DB the plan was scored on
     rules = dict(DEFAULT_RULES) if rules is None else dict(rules)
 
     cands, rejected = enumerate_candidates(nworld, info=info,
@@ -835,6 +843,16 @@ def apply_plan(eval_nodes, plan, info=None, _splice_rules=True):
     overrides = {}
     if info is None:
         info = graph_costs(eval_nodes)
+    if plan.dp > 1:
+        # dp plans bucket their gradient allreduce by default: the
+        # CostDB-derived bucket_bytes (4x the measured latency-
+        # bandwidth crossover, costdb.recommend_bucket_bytes) keeps
+        # `parallel="auto"` off the per-grad latency-regime pattern
+        # the HT904 lint prices — a user-supplied overlap_options
+        # value still wins in the executor's merge
+        from ..telemetry.costdb import recommend_bucket_bytes
+        overrides["overlap_options"] = {
+            "bucket_bytes": recommend_bucket_bytes(info.get("db"))}
     bindings = plan.bindings
     if plan.tp > 1 and _splice_rules:
         # a plan is often applied to a REBUILT graph (the bench's

@@ -46,7 +46,8 @@ import time
 
 __all__ = ["CostDB", "default_db_path", "record_profile", "record_spans",
            "comm_microbench", "ps_microbench", "COMM_KINDS",
-           "cold_start_ms", "cold_start_flops_ms", "main"]
+           "cold_start_ms", "cold_start_flops_ms",
+           "latency_crossover_bytes", "recommend_bucket_bytes", "main"]
 
 _DB_ENV = "HETU_COSTDB"
 _VERSION = 1
@@ -98,11 +99,19 @@ def _shape_str(shape):
 # PS RPC path (each ~an order below marketing peak — sustained, not burst)
 _COLD_GBPS = {"h2d": 8.0, "d2h": 8.0, "allreduce": 40.0, "p2p": 40.0,
               "ps_sparse_pull": 1.0, "ps_sparse_push": 1.0,
-              "ps_pull": 1.0, "ps_push": 1.0}
+              "ps_pull": 1.0, "ps_push": 1.0,
+              # a recompile is latency, not bytes: the GBps term only
+              # keeps the arithmetic uniform for the efficiency pass
+              "jit_compile": 1000.0}
 _COLD_LATENCY_MS = {"h2d": 0.1, "d2h": 0.1, "allreduce": 0.05,
                     "p2p": 0.02, "ps_sparse_pull": 0.3,
                     "ps_sparse_push": 0.3, "ps_pull": 0.3,
-                    "ps_push": 0.3}
+                    "ps_push": 0.3,
+                    # one XLA compile of a training step: hundreds of
+                    # ms is the conservative floor the HT901 recompile
+                    # lint prices against until a measured jit_compile
+                    # entry replaces it
+                    "jit_compile": 200.0}
 # assumed achievable compute rate for the FLOPs-proportional compute
 # fallback when NO op of a graph was ever profiled (GFLOP/s: a CPU-core
 # class floor — any real accelerator measurement replaces it)
@@ -359,6 +368,46 @@ class CostDB:
         if not cold_start:
             return None, None
         return cold_start_ms(kind, nbytes), "cold_start"
+
+
+# ---------------------------------------------------------------------------
+# derived knob recommendations (the planner/efficiency-lint queries)
+# ---------------------------------------------------------------------------
+
+# bucket-size clamp for gradient-allreduce bucketing: below 1 MiB a
+# bucket is still latency-dominated, above 64 MiB the tail collective
+# stops overlapping the remaining backward (the DDP paper's regime)
+_BUCKET_MIN = 1 << 20
+_BUCKET_MAX = 64 << 20
+_BUCKET_COLD = 4 << 20          # DDP's 25MB-class default, scaled down
+
+
+def latency_crossover_bytes(db, kind="allreduce"):
+    """Byte count where the fitted curve's bandwidth term equals its
+    latency term — transfers below it are latency-dominated (the
+    "fragmented collective" regime HT904 prices). Falls back to the
+    cold-start constants when the DB has no curve for ``kind``."""
+    cv = db.curve(kind) if db is not None else None
+    if cv is not None and cv.get("GBps"):
+        return int(cv["latency_ms"] * cv["GBps"] * 1e6)
+    return int(_COLD_LATENCY_MS.get(kind, 0.3)
+               * _COLD_GBPS.get(kind, 1.0) * 1e6)
+
+
+def recommend_bucket_bytes(db=None):
+    """CostDB-derived ``overlap_options.bucket_bytes`` default: 4x the
+    measured allreduce latency-bandwidth crossover (so a bucket is
+    ~80% bandwidth-bound), clamped to [1 MiB, 64 MiB]; the documented
+    4 MiB cold-start default when no curve exists. The autoplan
+    planner applies this to dp plans so ``parallel="auto"`` never
+    ships the per-grad (HT904) collective pattern by default."""
+    if db is None:
+        return _BUCKET_COLD
+    cv = db.curve("allreduce")
+    if cv is None or not cv.get("GBps"):
+        return _BUCKET_COLD
+    return int(min(_BUCKET_MAX, max(
+        _BUCKET_MIN, 4 * latency_crossover_bytes(db, "allreduce"))))
 
 
 # ---------------------------------------------------------------------------

@@ -318,6 +318,16 @@ def attribute_trace(path, tolerance=0.10):
 
 # -- diagnosis --------------------------------------------------------------
 
+# the static-verifier code that lints each bucket's pattern before a
+# launch (hetu_tpu/analysis/efficiency.py, DOCTOR_BUCKET inverted):
+# remediation lines cite it so the measured view and the priced static
+# report cross-reference — `python -m hetu_tpu.analysis.efficiency`
+# predicts what this diagnosis measures
+_REMEDY_CODE = {
+    "h2d_ingest": "HT905", "collective": "HT904", "jit": "HT901/HT907",
+    "unaccounted": "HT903", "compute": "HT902/HT906",
+}
+
 _REMEDY = {
     "h2d_ingest": "raise Executor(overlap_options={'lookahead': N}) "
                   "(and keep 'ingest': True) so feed H2D rides under "
@@ -340,6 +350,17 @@ _REMEDY = {
     "compute": "device-bound: tune kernels (HETU_AUTOTUNE, "
                "tune/probe.py) or scale the mesh",
 }
+
+
+def _remedy(bucket):
+    """Remediation string for a bucket, citing the matching HT9xx
+    static-lint code when one exists."""
+    text = _REMEDY.get(bucket, "")
+    code = _REMEDY_CODE.get(bucket)
+    if text and code:
+        text += (f" [static twin: {code} — "
+                 f"python -m hetu_tpu.analysis.efficiency]")
+    return text
 
 
 def diagnose(per_rank, costdb=None, bench=None, tolerance=0.10):
@@ -377,10 +398,12 @@ def diagnose(per_rank, costdb=None, bench=None, tolerance=0.10):
         "top_exposed_bucket": {
             "bucket": top[0], "ms_per_step": top[1],
             "fraction": round(top[1] / wall, 4),
-            "remedy": _REMEDY.get(top[0], "")},
+            "remedy": _remedy(top[0]),
+            "ht_code": _REMEDY_CODE.get(top[0])},
         "ranked_exposed": [
             {"bucket": b, "ms_per_step": v,
-             "fraction": round(v / wall, 4)} for b, v in ranked],
+             "fraction": round(v / wall, 4),
+             "ht_code": _REMEDY_CODE.get(b)} for b, v in ranked],
         "bubble_fraction": round(per_step.get("bubble", 0.0) / wall, 4),
         "comm_compute_ratio": round(comm / compute, 4)
         if compute > 0 else None,

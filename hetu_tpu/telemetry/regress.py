@@ -36,11 +36,16 @@ _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
                     "bubble_fraction": True, "autoplan_vs_hand": False}
 
-# informational per-record fields (the health monitor's stamps,
-# telemetry/health.py): reported so a reviewer sees a NaN run on its
-# face, but NEVER direction-compared — a loss_finite flip is a broken
-# run to investigate, not a perf ratio
-_INFORMATIONAL_FIELDS = ("loss_finite", "grad_norm_final")
+# informational per-record fields: the health monitor's stamps
+# (telemetry/health.py — a loss_finite flip is a broken run to
+# investigate, not a perf ratio) and the efficiency verifier's
+# (analysis/efficiency.py — estimated_ms_per_step is the *predicted*
+# per-step waste from the HT9xx priced lint and ht9xx_findings its
+# finding count; both are model outputs, not measurements, so a move
+# means the model changed, never that the build regressed). Reported
+# on their face, NEVER direction-compared.
+_INFORMATIONAL_FIELDS = ("loss_finite", "grad_norm_final",
+                         "estimated_ms_per_step", "ht9xx_findings")
 
 
 def _metric_lines(text):

@@ -395,7 +395,7 @@ def test_analysis_all_driver(tmp_path, capsys):
     doc = json.loads(out.read_text())
     assert doc["ok"] is True
     assert set(doc["gates"]) == {"zoo", "jit_purity", "concurrency",
-                                 "protocol", "numerics"}
+                                 "protocol", "numerics", "efficiency"}
     assert doc["sections"]["protocol"]["model"]["states"] > 1000
     assert "mlp" in doc["sections"]["zoo"]
 
