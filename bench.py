@@ -1131,6 +1131,177 @@ def bench_serving():
         ps_server.shutdown_server()
 
 
+def bench_serving_continuous():
+    """Continuous batching A/B (the ROADMAP item-1 headline): a closed-
+    loop high-concurrency mixed-length workload served by (a) the
+    request-level plane — dense ``GPTDecoder.generate`` behind the
+    ``MicroBatcher``, every prompt padded to the fleet prompt bucket and
+    every tick generating its longest member's length — and (b) the
+    iteration-level ``ContinuousBatchingEngine`` over the paged KV
+    cache, where sequences join/leave the running batch each step and
+    only real tokens are decoded. Identical workload (same RNG), both
+    systems fully warmed by one untimed pre-run. The claimed tokens/sec
+    is perfcheck-gated against the engine's own token counters
+    (``analysis/perfcheck.py:serving_claim_check``) — attributed, not
+    asserted."""
+    import threading
+
+    import jax
+
+    import hetu_tpu as ht
+    import hetu_tpu.models as M
+    from hetu_tpu import telemetry as tmod
+    from hetu_tpu.analysis.perfcheck import serving_claim_check
+    from hetu_tpu.serving import (ContinuousBatchingEngine, GPTDecoder,
+                                  InferenceSession, MicroBatcher,
+                                  next_bucket)
+
+    tel = _telemetry()
+    if not tel.enabled:
+        tel = tmod.configure(enabled=True, service="bench")
+
+    vocab, seq = 5000, 128
+    width = 8                   # running-batch width both systems get
+    # 2x more clients than batch slots: keeps BOTH planes saturated —
+    # the baseline's ticks form at full width and the engine's running
+    # batch refills the moment a sequence retires (a half-empty closed
+    # loop starves iteration-level scheduling of its whole advantage)
+    nclients, per_client = 16, 8
+    cfg = M.GPTConfig(vocab_size=vocab, hidden_size=384,
+                      num_hidden_layers=6, num_attention_heads=8,
+                      max_position_embeddings=seq,
+                      hidden_dropout_prob=0.0, use_flash_attention=True)
+    model = M.GPTLMHeadModel(cfg)
+    ids = ht.Variable("input_ids", trainable=False)
+    sess = InferenceSession([model(ids)], seq_buckets=(seq,),
+                            telemetry=tel)
+    dec = GPTDecoder.from_session(sess, cfg, telemetry=tel)
+
+    # one mixed-length workload, identical for both systems: prompts
+    # 8..24 tokens, outputs bimodal — mostly short (2..6) with a heavy
+    # tail of long (56..64), the serving mix where a request-level tick
+    # barrier (everyone decodes the tick's longest gen) wastes the most
+    # work
+    wrng = np.random.RandomState(7)
+
+    def _gen_len():
+        return int(wrng.randint(2, 7)) if wrng.rand() < 0.55 \
+            else int(wrng.randint(56, 65))
+
+    work = [[(wrng.randint(0, vocab, (int(wrng.randint(8, 25)),)),
+              _gen_len()) for _ in range(per_client)]
+            for _ in range(nclients)]
+    total_tokens = sum(g for reqs in work for _, g in reqs)
+    pmax_bucket = next_bucket(max(len(p) for reqs in work
+                                  for p, _ in reqs))
+
+    def run_clients(submit_one):
+        latencies, errors = [], []
+
+        def client(k):
+            try:
+                for p, g in work[k]:
+                    t0 = time.perf_counter()
+                    out = submit_one(p, g)
+                    latencies.append((time.perf_counter() - t0) * 1000)
+                    assert len(out) == g
+            except Exception as e:                  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(nclients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return wall, latencies
+
+    # ---- request-level baseline: MicroBatcher + dense GPTDecoder -----
+    # requests in one tick must share a prompt width, so the client
+    # plane pads every prompt to the fleet prompt bucket; the tick
+    # generates its longest member's gen length for everyone — exactly
+    # the request-level padding + barrier waste the engine deletes
+    def serve_tick(feeds):
+        x, gen = feeds["ids"], int(np.max(feeds["gen"]))
+        n = len(x)
+        b = next_bucket(n)
+        if b > n:               # keep decode compiles bucketed
+            x = np.concatenate([x, np.repeat(x[-1:], b - n, axis=0)])
+        return dec.generate(x, gen)[:n]
+
+    def pad_prompt(p):
+        return np.concatenate(
+            [p, np.repeat(p[-1:], pmax_bucket - len(p))])[None, :]
+
+    with MicroBatcher(serve_tick, max_batch_size=width, max_wait_ms=5,
+                      telemetry=tel, name="cb_base") as mb:
+        def base_one(p, g):
+            return mb.submit({"ids": pad_prompt(p),
+                              "gen": np.asarray([[g]])}).result(600)[0][:g]
+
+        run_clients(base_one)                       # untimed warm pass
+        base_wall, base_lat = run_clients(base_one)
+    base_tps = total_tokens / base_wall
+
+    # ---- iteration-level engine over the paged KV cache --------------
+    kw = dict(block_size=16, max_batch_size=width, telemetry=tel,
+              name="engine")
+    try:
+        # HT4xx-budgeted pool sizing (HETU_HBM_BUDGET / device limit)
+        engine = ContinuousBatchingEngine.from_session(sess, cfg, **kw)
+    except ValueError:          # CPU harness: no HBM budget resolvable
+        engine = ContinuousBatchingEngine.from_session(
+            sess, cfg, num_blocks=48, **kw)
+
+    def engine_one(p, g):
+        return engine.submit(p, g).result(600)
+
+    # two untimed warm passes: arrival jitter decides which batch-width
+    # buckets each pass hits, so one pass can leave (bb, cb) signatures
+    # cold that the timed pass would then pay to compile
+    run_clients(engine_one)
+    run_clients(engine_one)
+    engine.cache.peak_utilization = 0.0             # stamp = timed peak
+    c0 = tel.counter_value("engine_tokens")
+    wall, lat = run_clients(engine_one)
+    counted = tel.counter_value("engine_tokens") - c0
+    tps = total_tokens / wall
+
+    # attribution gate: the claimed rate must match what the engine's
+    # own token counters measured over the same window
+    ok, measured_tps = serving_claim_check(tps, counted, wall)
+    if not ok:
+        raise RuntimeError(
+            f"serving_claim_check failed: claimed {tps:.1f} tok/s vs "
+            f"counter-measured {measured_tps:.1f} tok/s over {wall:.2f}s "
+            f"({counted} counted vs {total_tokens} requested tokens)")
+
+    snap = {s["name"]: s for s in tel.metrics.snapshot()}
+    step_hist = snap.get("engine_step_ms", {})
+    ndev = jax.local_device_count()
+    emit("serving_tokens_per_sec_per_chip", tps / ndev,
+         "tokens/sec/chip", tps / base_tps if base_tps else 0.0,
+         serve_p50_ms=round(float(np.percentile(lat, 50)), 2),
+         serve_p99_ms=round(float(np.percentile(lat, 99)), 2),
+         baseline_p99_ms=round(float(np.percentile(base_lat, 99)), 2),
+         baseline_tokens_per_s=round(base_tps, 1),
+         counted_tokens_per_s=round(measured_tps, 1),
+         kv_hbm_utilization=round(engine.cache.peak_utilization, 4),
+         kv_blocks=engine.cache.num_blocks,
+         engine_jit_compiles=engine.jit_compiles,
+         engine_compile_bound=engine.compile_bound,
+         requests=nclients * per_client, clients=nclients,
+         h2d_MBps=h2d_probe_mbps(),
+         step_ms_p50=round(float(step_hist.get("p50", 0.0)), 3),
+         step_ms_p95=round(float(step_hist.get("p95", 0.0)), 3))
+    engine.close()
+    sess.close()
+
+
 def bench_pp():
     """Pipeline-parallel step-time microbench: 2-stage GPipe MLP, 4
     microbatches, compiled schedule. On this one-chip bench host
@@ -1739,8 +1910,9 @@ def main():
 
     units = (bench_logreg, bench_mlp_cifar, bench_wdl_ps,
              bench_wdl_ps_host, bench_wdl_hybrid, bench_ncf, bench_gcn,
-             bench_serving, bench_pp, bench_pp_modes, bench_autoplan,
-             bench_bert_long_seq, bench_gpt, bench_bert)
+             bench_serving, bench_serving_continuous, bench_pp,
+             bench_pp_modes, bench_autoplan, bench_bert_long_seq,
+             bench_gpt, bench_bert)
     # `python bench.py serving gpt` runs just those units (name match
     # against bench_<arg>); no args = the full suite, headline last
     import sys

@@ -44,8 +44,8 @@ from .findings import Report
 from .efficiency import DOCTOR_BUCKET, efficiency_pass
 
 __all__ = ["measure_buckets", "soundness_pass", "perfcheck_model",
-           "ab_bucketed_allreduce", "SOUND_FACTOR", "SOUND_SLACK_MS",
-           "AB_TOLERANCE", "main"]
+           "ab_bucketed_allreduce", "serving_claim_check",
+           "SOUND_FACTOR", "SOUND_SLACK_MS", "AB_TOLERANCE", "main"]
 
 # a priced claim survives while estimated_ms_per_step <= SOUND_FACTOR x
 # measured-bucket ms/step + SOUND_SLACK_MS: the factor absorbs the
@@ -117,6 +117,27 @@ def soundness_pass(findings, measured_buckets, report=None,
                 claimed_ms=round(float(claim), 6),
                 measured_ms=round(measured, 6))
     return report, checked
+
+
+def serving_claim_check(claimed_tokens_per_s, counted_tokens, wall_s,
+                        factor=SOUND_FACTOR):
+    """The serving half of the HT910 attribution discipline: a bench's
+    *claimed* tokens/sec must agree with the rate its own telemetry
+    counters support — ``counted_tokens`` (the engine's ``<name>_tokens``
+    counter delta over the measured window) divided by the window's
+    wall clock. Within ``factor`` either way the claim is attributed;
+    outside it, the bench's workload arithmetic and the engine's token
+    accounting have drifted apart and the number is asserted, not
+    measured. Returns ``(ok, measured_tokens_per_s)``."""
+    wall_s = float(wall_s)
+    if wall_s <= 0 or counted_tokens <= 0:
+        return False, 0.0
+    measured = float(counted_tokens) / wall_s
+    claimed = float(claimed_tokens_per_s)
+    if claimed <= 0:
+        return False, measured
+    ratio = claimed / measured
+    return (1.0 / factor) <= ratio <= factor, measured
 
 
 def _constant_feeds(feed_history, report, costdb=None):

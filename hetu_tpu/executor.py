@@ -843,7 +843,26 @@ class SubExecutor:
                 logging.getLogger(__name__).warning("preflight: %s", f)
                 if self.config.analysis_report is not None:
                     self.config.analysis_report.findings.append(f)
-        return compiled
+
+        # an AOT-compiled object pins its input shardings; a TP/SPMD
+        # step hands back new_params SHARDED, so the second call would
+        # die with "Compiled object called with input sharding(s)..."
+        # where the implicit-jit path just recompiles. Self-heal: the
+        # mismatch is raised at argument validation (before execution,
+        # donated buffers untouched), so fall back to the jit path once
+        # and stay there.
+        state = {"fn": compiled}
+
+        def dispatch(*a):
+            try:
+                return state["fn"](*a)
+            except ValueError as e:
+                if state["fn"] is jitted or "sharding" not in str(e):
+                    raise
+                state["fn"] = jitted
+                return jitted(*a)
+
+        return dispatch
 
     @contextlib.contextmanager
     def _compile_span(self, key):

@@ -27,7 +27,8 @@ from .bert import (BertLayerNorm as LayerNorm, Dropout, Embedding,
 
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel",
            "gpt_param_names", "gpt_serving_params", "init_kv_cache",
-           "gpt_prefill", "gpt_cached_step"]
+           "gpt_prefill", "gpt_cached_step",
+           "gpt_paged_prefill", "gpt_paged_step"]
 
 
 class GPTConfig:
@@ -200,6 +201,95 @@ def gpt_cached_step(params, kv, tokens, pos, num_heads,
         x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
     x = _serve_ln(x, params["ln_f"])
     return x @ params["lm_head"], new_kv
+
+
+def _pool_scatter(pool, slots, rows):
+    """Write ``rows [N, H, D]`` into flat slots of one layer's pooled
+    cache ``[num_blocks, block_size, H, D]``. Duplicate slots (padded
+    lanes all targeting the scratch block) resolve to SOME written row
+    — fine, scratch content is never read unmasked."""
+    shape = pool.shape
+    flat = pool.reshape(-1, *shape[2:])
+    return flat.at[slots].set(rows, mode="drop").reshape(shape)
+
+
+def gpt_paged_prefill(params, pools, ids, slot_idx, num_heads,
+                      hidden_act="gelu"):
+    """Prompt phase over a block-paged pool: full causal forward over
+    ``ids`` ``[B, P]`` that scatters every position's K/V row into the
+    flat pool slots ``slot_idx`` ``[B, P]`` (kvcache.py block-table
+    math; padded rows/positions point at the scratch block). Prompts in
+    the batch may have different true lengths — rows past a prompt's
+    end are edge-repeat padding whose K/V lands in scratch, and causal
+    attention keeps them out of the real rows' context. Returns
+    ``(logits [B, P, V], pools)``; jit with ``pools`` donated."""
+    from ..ops.attention import prefill_attention
+
+    act = _serve_act(hidden_act)
+    b, p = ids.shape
+    hidden = params["wte"].shape[1]
+    hs = hidden // num_heads
+    x = params["wte"][ids] + params["wpe"][:p][None]
+    flat_slots = slot_idx.reshape(b * p)
+    new_pools = []
+    for blk, pool in zip(params["blocks"], pools):
+        h = _serve_ln(x, blk["ln1"])
+        qkv = h @ blk["qkv"][0] + blk["qkv"][1]           # [B, P, 3H]
+        q, k, v = (qkv[..., i * hidden:(i + 1) * hidden]
+                   .reshape(b, p, num_heads, hs).transpose(0, 2, 1, 3)
+                   for i in range(3))
+        new_pools.append({
+            "k": _pool_scatter(pool["k"], flat_slots,
+                               k.transpose(0, 2, 1, 3)
+                               .reshape(b * p, num_heads, hs)),
+            "v": _pool_scatter(pool["v"], flat_slots,
+                               v.transpose(0, 2, 1, 3)
+                               .reshape(b * p, num_heads, hs))})
+        ctx = prefill_attention(q, k, v,
+                                sm_scale=1.0 / float(np.sqrt(hs)),
+                                causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, p, hidden)
+        x = x + (ctx @ blk["proj"][0] + blk["proj"][1])
+        x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
+    x = _serve_ln(x, params["ln_f"])
+    return x @ params["lm_head"], new_pools
+
+
+def gpt_paged_step(params, pools, tokens, positions, slot_idx,
+                   write_slots, num_heads, hidden_act="gelu"):
+    """Paged single-token forward for a RAGGED batch: ``tokens`` ``[B]``
+    each at its own position ``positions`` ``[B]`` (traced int32 — one
+    jit program serves every mix of sequence lengths at this batch/
+    context bucket). Writes each token's K/V row to flat pool slot
+    ``write_slots`` ``[B]`` and attends through the gathered slot grid
+    ``slot_idx`` ``[B, S_bucket]`` via
+    :func:`~hetu_tpu.ops.attention.paged_decode_attention`. Padded
+    lanes carry ``write_slots`` = scratch and gather behind the length
+    mask. Returns ``(logits [B, V], pools)``; jit with ``pools``
+    donated so updates stay in-HBM."""
+    from ..ops.attention import paged_decode_attention
+
+    act = _serve_act(hidden_act)
+    hidden = params["wte"].shape[1]
+    hs = hidden // num_heads
+    b = tokens.shape[0]
+    x = params["wte"][tokens] + params["wpe"][positions]    # [B, H]
+    new_pools = []
+    for blk, pool in zip(params["blocks"], pools):
+        h = _serve_ln(x, blk["ln1"])
+        qkv = h @ blk["qkv"][0] + blk["qkv"][1]             # [B, 3H]
+        q, k, v = (qkv[:, i * hidden:(i + 1) * hidden]
+                   .reshape(b, num_heads, hs) for i in range(3))
+        k_pool = _pool_scatter(pool["k"], write_slots, k)
+        v_pool = _pool_scatter(pool["v"], write_slots, v)
+        new_pools.append({"k": k_pool, "v": v_pool})
+        ctx = paged_decode_attention(q, k_pool, v_pool, slot_idx,
+                                     positions,
+                                     sm_scale=1.0 / float(np.sqrt(hs)))
+        x = x + (ctx.reshape(b, hidden) @ blk["proj"][0] + blk["proj"][1])
+        x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
+    x = _serve_ln(x, params["ln_f"])
+    return x @ params["lm_head"], new_pools
 
 
 class CausalSelfAttention:

@@ -23,7 +23,8 @@ from ..graph.node import Op
 __all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference",
            "ring_attention_op", "RingAttentionOp",
            "ulysses_attention_op", "UlyssesAttentionOp",
-           "decode_attention", "prefill_attention"]
+           "decode_attention", "prefill_attention",
+           "paged_decode_attention"]
 
 
 def attention_reference(q, k, v, mask, sm_scale):
@@ -61,6 +62,38 @@ def decode_attention(q, k_cache, v_cache, pos, sm_scale):
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhs,bhsd->bhd", probs.astype(v_cache.dtype),
                       v_cache)
+
+
+def paged_decode_attention(q, k_pool, v_pool, slot_idx, positions,
+                           sm_scale):
+    """One query token per sequence against a block-paged KV pool.
+
+    ``q`` is ``[B, H, D]``; ``k_pool`` / ``v_pool`` are one layer's
+    pooled cache, either ``[num_blocks, block_size, H, D]`` or already
+    flattened ``[num_blocks * block_size, H, D]``; ``slot_idx`` is
+    ``[B, S]`` int32 — the flat pool slot holding position ``j`` of
+    sequence ``b`` (serving/kvcache.py block-table math, computed
+    host-side; out-of-range positions point at the scratch block);
+    ``positions`` is ``[B]`` int32, the 0-based position of each
+    sequence's CURRENT token, so sequences of different lengths decode
+    in the same call. Returns ``[B, H, D]``.
+
+    Unlike :func:`decode_attention` there is no per-sequence dense
+    ``S_max`` cache: K/V rows are gathered through the block table, so
+    the per-step cost is O(S_bucket * D) over a *shared* pool and HBM
+    holds only the blocks live sequences actually use. Causality/
+    raggedness is the ``j <= positions[b]`` validity mask — scratch
+    rows gathered past a sequence's length sit behind it."""
+    if k_pool.ndim == 4:
+        k_pool = k_pool.reshape(-1, *k_pool.shape[2:])
+        v_pool = v_pool.reshape(-1, *v_pool.shape[2:])
+    k = k_pool[slot_idx]                                # [B, S, H, D]
+    v = v_pool[slot_idx]
+    scores = jnp.einsum("bhd,bshd->bhs", q * sm_scale, k)
+    valid = jnp.arange(slot_idx.shape[1])[None, :] <= positions[:, None]
+    scores = jnp.where(valid[:, None, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)
 
 
 def prefill_attention(q, k, v, sm_scale, causal=True):

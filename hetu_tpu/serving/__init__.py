@@ -24,13 +24,27 @@ checkpoints and sparse tables; serving composes them into four pieces:
 * :class:`~hetu_tpu.serving.http.ServingHTTPServer` — minimal stdlib
   JSON frontend over a session or batcher (``/v1/predict``, ``/healthz``,
   ``/metrics``).
+* the continuous-batching plane —
+  :class:`~hetu_tpu.serving.kvcache.PagedKVCache` (block-paged pooled
+  K/V + free-list allocator, HBM-budgeted via HT4xx),
+  :class:`~hetu_tpu.serving.scheduler.ContinuousBatchingEngine`
+  (iteration-level join/leave scheduling over the paged cache, HT901
+  bucketed jit signatures, KV-block admission control), and
+  :class:`~hetu_tpu.serving.router.ReplicaRouter` (SLO-probed
+  least-inflight routing + load shedding over N replicas).
 """
 from .session import InferenceSession, next_bucket
 from .batcher import MicroBatcher
 from .decode import GPTDecoder
 from .embedding import ReadOnlyPSClient, serve_embeddings_from_ps
 from .http import ServingHTTPServer
+from .kvcache import BlockAllocator, KVCacheExhausted, PagedKVCache
+from .router import ReplicaRouter, RouterOverloaded, SLOWindow
+from .scheduler import ContinuousBatchingEngine, EngineOverloaded
 
 __all__ = ["InferenceSession", "MicroBatcher", "GPTDecoder",
            "ReadOnlyPSClient", "serve_embeddings_from_ps",
-           "ServingHTTPServer", "next_bucket"]
+           "ServingHTTPServer", "next_bucket",
+           "BlockAllocator", "KVCacheExhausted", "PagedKVCache",
+           "ContinuousBatchingEngine", "EngineOverloaded",
+           "ReplicaRouter", "RouterOverloaded", "SLOWindow"]

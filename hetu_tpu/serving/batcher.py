@@ -4,8 +4,9 @@ Concurrent ``submit()`` calls coalesce into ONE forward per tick: the
 batcher thread claims up to ``max_batch_size`` rows, waiting at most
 ``max_wait_ms`` for stragglers after the first request arrives, then
 concatenates the feeds along the batch dim, runs ``serve_fn`` once, and
-splits the outputs back per request. Requests stay whole — a request's
-rows never split across ticks.
+splits the outputs back per request. Requests up to ``max_batch_size``
+stay whole — their rows never split across ticks; wider requests split
+server-side into adjacent chunks that resolve through one Future.
 
 Telemetry (through ``hetu_tpu/telemetry/metrics.py``): ``<name>_queue_depth``
 gauge, ``<name>_latency_ms`` p50/p95/p99 histogram (submit -> result),
@@ -15,6 +16,7 @@ gauge, ``<name>_latency_ms`` p50/p95/p99 histogram (submit -> result),
 from __future__ import annotations
 
 import collections
+import functools
 import threading
 import time
 from concurrent.futures import Future
@@ -24,6 +26,25 @@ import numpy as np
 from .. import telemetry as _telemetry
 
 __all__ = ["MicroBatcher"]
+
+
+def _stitch_chunks(results, n):
+    """Reassemble per-chunk serve outputs into one request's view:
+    row-sliced outputs (chunk first-dims summing to ``n``) concatenate
+    back in chunk order; whole-batch passthrough outputs (the
+    ``_serve`` non-sliceable case) are identical per chunk, so the
+    first chunk's copy stands for the request."""
+    single = not isinstance(results[0], (list, tuple))
+    width = 1 if single else len(results[0])
+    out = []
+    for j in range(width):
+        pieces = [r if single else r[j] for r in results]
+        if all(getattr(p, "ndim", 0) for p in pieces) and \
+                sum(p.shape[0] for p in pieces) == n:
+            out.append(np.concatenate([np.asarray(p) for p in pieces]))
+        else:
+            out.append(pieces[0])
+    return out[0] if single else out
 
 
 class _Request:
@@ -70,9 +91,11 @@ class MicroBatcher:
                 f"request feeds disagree on batch size: {sorted(sizes)}")
         n = sizes.pop()
         if n > self.max_batch_size:
-            raise ValueError(
-                f"request of {n} rows exceeds max_batch_size "
-                f"{self.max_batch_size}; split it client-side")
+            # oversized requests split server-side across ticks: the
+            # chunks enqueue adjacently (FIFO keeps row order), and ONE
+            # combining Future stitches the per-chunk outputs back in
+            # request row order
+            return self._submit_split(arrays, n)
         req = _Request(arrays, n, Future())
         with self._cond:
             # submit/close race contract (pinned by the racecheck
@@ -86,6 +109,50 @@ class MicroBatcher:
             self._set_depth()
             self._cond.notify()
         return req.future
+
+    def _submit_split(self, arrays, n):
+        """Split an ``n > max_batch_size`` request into consecutive
+        chunks enqueued atomically (they stay adjacent in the FIFO, so
+        the rows come back in submission order even when they land in
+        different ticks) and return ONE Future resolving to the stitched
+        outputs. The first chunk failure fails the whole request."""
+        size = self.max_batch_size
+        chunks = []
+        for off in range(0, n, size):
+            sub = {k: (v[off:off + size] if v.ndim else v)
+                   for k, v in arrays.items()}
+            chunks.append(_Request(sub, min(size, n - off), Future()))
+        combined = Future()
+        state_lock = threading.Lock()
+        pending = [len(chunks)]
+        results = [None] * len(chunks)
+
+        def _done(i, fut):
+            with state_lock:
+                exc = fut.exception()
+                if exc is not None:
+                    if not combined.done():
+                        combined.set_exception(exc)
+                    return
+                results[i] = fut.result()
+                pending[0] -= 1
+                if pending[0] == 0 and not combined.done():
+                    try:
+                        combined.set_result(_stitch_chunks(results, n))
+                    except Exception as e:          # noqa: BLE001
+                        combined.set_exception(e)
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher closed")
+            self._queue.extend(chunks)
+            self._set_depth()
+            self._cond.notify_all()
+        if self.telemetry.enabled:
+            self.telemetry.inc(f"{self.name}_split_requests")
+        for i, req in enumerate(chunks):
+            req.future.add_done_callback(functools.partial(_done, i))
+        return combined
 
     def _set_depth(self):
         if self.telemetry.enabled:

@@ -91,15 +91,27 @@ class GPTDecoder:
         return cls(config, lookup, **kw)
 
     # ------------------------------------------------------------------
-    def prefill(self, ids):
+    def prefill(self, ids, real_len=None):
         """Prompt phase over ``ids [B, P]``: returns
-        ``(logits [B, P, V], kv)`` with K/V rows ``0..P-1`` written."""
+        ``(logits [B, P, V], kv)`` with K/V rows ``0..P-1`` written.
+
+        ``real_len`` is the true prompt length when ``ids`` arrives
+        already bucket-padded (generate() passes it): the
+        ``decode_prefill_tokens`` counter counts only REAL tokens, and
+        the padding overhead lands in ``decode_prefill_pad_tokens`` so
+        bucketing waste stays visible instead of inflating the work
+        counter."""
         ids = jnp.asarray(ids, jnp.int32)
         kv = init_kv_cache(self.config, ids.shape[0], self.max_len)
         logits, kv = self._prefill(self.params, kv, ids)
         if self.telemetry.enabled:
-            self.telemetry.inc("decode_prefill_tokens", int(np.prod(
-                ids.shape)))
+            b, p = ids.shape
+            real = b * min(int(real_len), p) if real_len is not None \
+                else b * p
+            self.telemetry.inc("decode_prefill_tokens", real)
+            if b * p > real:
+                self.telemetry.inc("decode_prefill_pad_tokens",
+                                   b * p - real)
         return logits, kv
 
     def decode_step(self, kv, tokens, pos):
@@ -140,7 +152,7 @@ class GPTDecoder:
         if pb > p:
             pad = np.repeat(prompts[:, -1:], pb - p, axis=1)
             logits, kv = self.prefill(
-                np.concatenate([prompts, pad], axis=1))
+                np.concatenate([prompts, pad], axis=1), real_len=p)
         else:
             logits, kv = self.prefill(prompts)
         last = logits[:, p - 1]

@@ -21,12 +21,12 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from .. import telemetry as _telemetry
+from .router import SLOWindow
 
 __all__ = ["ServingHTTPServer"]
 
@@ -50,8 +50,9 @@ class ServingHTTPServer:
         self.request_timeout_s = float(request_timeout_s)
         self.slo_p99_ms = slo_p99_ms
         self.slo_error_rate = slo_error_rate
-        self._window = deque(maxlen=int(slo_window))  # (ok, latency_ms)
-        self._window_lock = threading.Lock()
+        # one shared breach definition with the replica router and the
+        # decode engine (serving/router.py)
+        self._slo = SLOWindow(slo_p99_ms, slo_error_rate, slo_window)
         self._httpd = None
         self._thread = None
         # the session backend is NOT thread-safe (shape inference writes
@@ -60,32 +61,11 @@ class ServingHTTPServer:
         self._backend_lock = threading.Lock()
 
     def _note_request(self, ok, ms):
-        with self._window_lock:
-            self._window.append((bool(ok), float(ms)))
+        self._slo.note(ok, ms)
 
     def health(self):
         """(healthy, reason) under the configured SLOs."""
-        if self.slo_p99_ms is None and self.slo_error_rate is None:
-            return True, "ok"
-        with self._window_lock:
-            window = list(self._window)
-        if not window:
-            return True, "ok (no traffic)"
-        if self.slo_error_rate is not None:
-            rate = sum(1 for ok, _ in window if not ok) / len(window)
-            if rate > self.slo_error_rate:
-                return False, (f"error rate {rate:.3f} > SLO "
-                               f"{self.slo_error_rate:.3f} over "
-                               f"{len(window)} requests")
-        if self.slo_p99_ms is not None:
-            lats = [ms for ok, ms in window if ok]
-            if lats:
-                p99 = float(np.percentile(lats, 99))
-                if p99 > self.slo_p99_ms:
-                    return False, (f"serve_latency_ms p99 {p99:.1f} > "
-                                   f"SLO {self.slo_p99_ms:.1f} over "
-                                   f"{len(lats)} requests")
-        return True, "ok"
+        return self._slo.health()
 
     # ------------------------------------------------------------------
     def _predict(self, inputs):

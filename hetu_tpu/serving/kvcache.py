@@ -1,0 +1,289 @@
+"""Paged KV cache: fixed-size block pools + a block allocator.
+
+The dense decode path (``serving/decode.py``) preallocates one
+``[B, H, S_max, D]`` K/V pair per layer per batch — every sequence pays
+for ``S_max`` positions whether it uses 8 or 800, and a new batch means
+a new allocation. This module is the vLLM/PagedAttention shape instead:
+
+* **one pooled buffer per layer** — ``[num_blocks, block_size, H, D]``
+  for K and V, allocated once and shared by every sequence the engine
+  ever serves;
+* **per-sequence block tables** — a sequence owns an ordered list of
+  block ids; token position ``j`` lives in flat pool slot
+  ``table[j // block_size] * block_size + j % block_size``. Sequences
+  are contiguous *logically*, scattered *physically*;
+* **a free-list allocator** with deterministic exhaustion behavior:
+  ``alloc`` is all-or-nothing and raises :class:`KVCacheExhausted`
+  (never partially allocates, never corrupts a neighbor's blocks);
+  freed blocks return to the list in a deterministic order.
+
+**Physical block 0 is the scratch block.** Padded batch lanes (the
+bucketing that keeps jit signatures bounded) write their garbage K/V
+rows to slot ``0..block_size-1`` and gather from them behind a length
+mask; the allocator never hands block 0 to a real sequence, so padding
+can never corrupt live cache rows.
+
+Sizing rides the HT4xx machinery (``analysis/memory.py``): with
+``num_blocks=None`` the pool sizes itself against the resolved HBM
+budget (explicit argument > ``HETU_HBM_BUDGET`` > the device's
+advertised ``bytes_limit``) minus the model's parameter bytes and a
+headroom fraction. On a CPU harness with no budget resolvable, pass
+``num_blocks`` explicitly.
+"""
+from __future__ import annotations
+
+import collections
+import math
+
+import numpy as np
+
+__all__ = ["KVCacheExhausted", "BlockAllocator", "PagedKVCache",
+           "kv_block_bytes", "gpt_param_bytes", "blocks_for_budget",
+           "DEFAULT_BLOCK_SIZE"]
+
+DEFAULT_BLOCK_SIZE = 16
+
+# fraction of the resolved HBM budget kept free for activations /
+# compiler temps when auto-sizing the pool (the static HT4xx estimate
+# is deliberately pessimistic the other way; serving steps are small)
+_BUDGET_HEADROOM = 0.10
+
+
+class KVCacheExhausted(RuntimeError):
+    """Raised by :meth:`BlockAllocator.alloc` when the free list cannot
+    cover a request. All-or-nothing: no blocks were allocated. The
+    engine's admission plane turns this into queueing/rejection; seeing
+    it escape means a caller bypassed admission control."""
+
+
+def kv_block_bytes(config, block_size, dtype_bytes=4):
+    """HBM bytes one cache block costs across ALL layers (K + V)."""
+    return (2 * config.num_hidden_layers * int(block_size)
+            * config.hidden_size * dtype_bytes)
+
+
+def gpt_param_bytes(config, dtype_bytes=4):
+    """Parameter bytes of a ``GPTLMHeadModel`` with this config (the
+    serving-params pytree ``models/gpt.py:gpt_serving_params`` builds)
+    — what the pool sizing subtracts from the HBM budget."""
+    h = config.hidden_size
+    i = config.intermediate_size
+    per_layer = (2 * h                      # ln1
+                 + h * 3 * h + 3 * h        # qkv
+                 + h * h + h                # attn proj
+                 + 2 * h                    # ln2
+                 + h * i + i                # mlp fc
+                 + i * h + h)               # mlp proj
+    total = (config.vocab_size * h          # wte
+             + config.max_position_embeddings * h   # wpe
+             + config.num_hidden_layers * per_layer
+             + 2 * h                        # ln_f
+             + h * config.vocab_size)       # lm_head
+    return total * dtype_bytes
+
+
+def blocks_for_budget(config, block_size=DEFAULT_BLOCK_SIZE, budget=None,
+                      headroom=_BUDGET_HEADROOM):
+    """KV blocks the resolved HBM budget affords after the model's
+    parameters and a headroom fraction. Returns ``None`` when no budget
+    resolves (CPU harness without ``HETU_HBM_BUDGET``); raises when a
+    budget resolves but can't fit even two blocks."""
+    from ..analysis.memory import fmt_bytes, resolve_budget
+    budget = resolve_budget(budget)
+    if budget is None:
+        return None
+    avail = int(budget * (1.0 - headroom)) - gpt_param_bytes(config)
+    nb = avail // kv_block_bytes(config, block_size)
+    if nb < 2:
+        raise ValueError(
+            f"HBM budget {fmt_bytes(budget)} leaves room for {nb} KV "
+            f"block(s) after {fmt_bytes(gpt_param_bytes(config))} of "
+            f"parameters — the model doesn't fit a paged cache here")
+    return int(nb)
+
+
+class BlockAllocator:
+    """Free-list over ``num_blocks`` usable block ids.
+
+    ``alloc(n)`` is all-or-nothing (raises :class:`KVCacheExhausted`
+    listing need vs. free, allocating nothing). Blocks hand out
+    lowest-id-first and freed blocks rejoin in sorted order, so
+    identical alloc/free traces produce identical tables — exhaustion
+    and reuse are deterministic, not load-dependent."""
+
+    def __init__(self, num_blocks, block_size, first_id=0):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._first = int(first_id)
+        self._free = collections.deque(
+            range(self._first, self._first + self.num_blocks))
+        self._live = set()
+
+    @property
+    def available(self):
+        return len(self._free)
+
+    @property
+    def used(self):
+        return len(self._live)
+
+    def blocks_for_tokens(self, ntokens):
+        return max(1, math.ceil(int(ntokens) / self.block_size))
+
+    def alloc(self, n):
+        n = int(n)
+        if n > len(self._free):
+            raise KVCacheExhausted(
+                f"KV cache exhausted: need {n} block(s), "
+                f"{len(self._free)} free of {self.num_blocks}")
+        out = [self._free.popleft() for _ in range(n)]
+        self._live.update(out)
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            if b not in self._live:
+                raise ValueError(f"double free of KV block {b}")
+            self._live.discard(b)
+        # sorted re-insertion keeps reuse deterministic regardless of
+        # the order sequences finished in
+        self._free = collections.deque(
+            sorted(list(self._free) + list(blocks)))
+
+
+class PagedKVCache:
+    """Per-layer pooled K/V buffers + per-sequence block tables.
+
+    The pools are jax arrays the engine threads through its (donated)
+    jit calls; everything else — tables, the allocator, slot math — is
+    host-side numpy. ``config`` is GPT-shaped (``num_hidden_layers``,
+    ``num_attention_heads``, ``hidden_size``).
+    """
+
+    def __init__(self, config, num_blocks=None,
+                 block_size=DEFAULT_BLOCK_SIZE, budget=None,
+                 telemetry=None):
+        from .. import telemetry as _telemetry
+        self.config = config
+        self.block_size = int(block_size)
+        if num_blocks is None:
+            num_blocks = blocks_for_budget(config, self.block_size,
+                                           budget)
+            if num_blocks is None:
+                raise ValueError(
+                    "no HBM budget resolvable to size the KV pool "
+                    "(CPU harness?): pass num_blocks= explicitly or "
+                    "set HETU_HBM_BUDGET")
+        self.num_blocks = int(num_blocks)
+        self.telemetry = _telemetry.resolve(telemetry)
+        # block 0 is the scratch block padded lanes target; real
+        # sequences allocate from 1..num_blocks
+        self.allocator = BlockAllocator(self.num_blocks, self.block_size,
+                                        first_id=1)
+        self.pools = self._init_pools()
+        self.tables = {}            # seq_id -> [block ids]
+        self.peak_utilization = 0.0
+
+    def _init_pools(self):
+        import jax.numpy as jnp
+        nh = self.config.num_attention_heads
+        hs = self.config.hidden_size // nh
+        shape = (self.num_blocks + 1, self.block_size, nh, hs)
+        return [{"k": jnp.zeros(shape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.float32)}
+                for _ in range(self.config.num_hidden_layers)]
+
+    # -- accounting ------------------------------------------------------
+    @property
+    def used_blocks(self):
+        return self.allocator.used
+
+    @property
+    def utilization(self):
+        """Fraction of the (non-scratch) pool held by live sequences."""
+        return self.allocator.used / self.num_blocks
+
+    def hbm_bytes(self):
+        """Bytes the pools occupy (scratch block included)."""
+        return kv_block_bytes(self.config, self.block_size) \
+            * (self.num_blocks + 1)
+
+    def can_admit(self, ntokens):
+        return self.allocator.blocks_for_tokens(ntokens) \
+            <= self.allocator.available
+
+    def fits_at_all(self, ntokens):
+        """Whether a sequence of ``ntokens`` could EVER be served by
+        this pool (the submit-time guard)."""
+        return self.allocator.blocks_for_tokens(ntokens) \
+            <= self.allocator.num_blocks
+
+    def _note_util(self):
+        u = self.utilization
+        if u > self.peak_utilization:
+            self.peak_utilization = u
+        if self.telemetry.enabled:
+            self.telemetry.set_gauge("kv_blocks_used",
+                                     self.allocator.used)
+            self.telemetry.set_gauge("kv_hbm_utilization", u)
+
+    # -- sequence lifecycle ---------------------------------------------
+    def add_seq(self, seq_id, ntokens):
+        """Allocate blocks covering ``ntokens`` positions for a new
+        sequence (all-or-nothing; raises :class:`KVCacheExhausted`)."""
+        if seq_id in self.tables:
+            raise ValueError(f"sequence {seq_id} already has a table")
+        blocks = self.allocator.alloc(
+            self.allocator.blocks_for_tokens(ntokens))
+        self.tables[seq_id] = blocks
+        self._note_util()
+        return blocks
+
+    def extend_seq(self, seq_id, ntokens):
+        """Grow a sequence's table to cover ``ntokens`` total positions
+        (no-op when it already does)."""
+        table = self.tables[seq_id]
+        need = self.allocator.blocks_for_tokens(ntokens) - len(table)
+        if need > 0:
+            table.extend(self.allocator.alloc(need))
+            self._note_util()
+        return table
+
+    def free_seq(self, seq_id):
+        blocks = self.tables.pop(seq_id, None)
+        if blocks:
+            self.allocator.free(blocks)
+        self._note_util()
+
+    def capacity_tokens(self, seq_id):
+        return len(self.tables[seq_id]) * self.block_size
+
+    # -- slot math (host-side; the jit programs take these as inputs) ---
+    def slot_of(self, seq_id, pos):
+        """Flat pool slot of one position."""
+        table = self.tables[seq_id]
+        return table[pos // self.block_size] * self.block_size \
+            + pos % self.block_size
+
+    def slot_mapping(self, seq_id, start, stop):
+        """Flat slots for positions ``[start, stop)`` as int32."""
+        table = np.asarray(self.tables[seq_id], np.int32)
+        pos = np.arange(start, stop)
+        return (table[pos // self.block_size] * self.block_size
+                + pos % self.block_size).astype(np.int32)
+
+    def gather_slots(self, seq_ids, width):
+        """``[len(seq_ids), width]`` int32 slot grid covering positions
+        ``0..width-1`` per sequence; positions beyond a sequence's
+        allocated blocks point at the scratch block (they sit behind
+        the attention length mask anyway)."""
+        bs = self.block_size
+        off = np.arange(width, dtype=np.int64)
+        out = np.zeros((len(seq_ids), width), np.int32)
+        for i, sid in enumerate(seq_ids):
+            table = np.asarray(self.tables[sid], np.int64)
+            cap = len(table) * bs
+            w = min(width, cap)
+            out[i, :w] = (table[off[:w] // bs] * bs
+                          + off[:w] % bs).astype(np.int32)
+        return out

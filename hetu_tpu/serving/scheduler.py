@@ -1,0 +1,515 @@
+"""Iteration-level continuous batching over the paged KV cache.
+
+``GPTDecoder.generate`` + ``MicroBatcher`` is *request-level* batching:
+a tick's requests fuse into one batch that prefills together, decodes
+together, and finishes together — every sequence pays the longest
+member's generation length, a late arrival waits for the whole batch,
+and each batch allocates dense ``[B, H, S_max, D]`` cache buffers.
+
+:class:`ContinuousBatchingEngine` schedules at *iteration* granularity
+instead (Orca, OSDI '22), over the block-paged cache of
+``serving/kvcache.py`` (vLLM's PagedAttention, SOSP '23). Every
+scheduler step:
+
+1. **finish** — sequences that produced their last token leave the
+   batch immediately, resolve their Future, and free their KV blocks;
+2. **admit** — waiting requests join while batch width and free KV
+   blocks allow. Admission is the only gate on cache memory:
+   ``admission="queue"`` (default) holds the FIFO head until blocks
+   free up, ``admission="reject"`` fails its Future with
+   :class:`EngineOverloaded` instead (load shedding at the engine). A
+   request that could NEVER fit the pool raises
+   :class:`~hetu_tpu.serving.kvcache.KVCacheExhausted` at submit;
+3. **prefill** — newly admitted prompts run one causal forward
+   (grouped per prompt bucket) that scatters their K/V rows into the
+   pool via ``models/gpt.py:gpt_paged_prefill``;
+4. **decode** — ALL running sequences take one token step in ONE jit
+   program (``gpt_paged_step``): per-sequence position vectors make
+   the batch ragged-safe, block tables make it gather from the pool.
+
+**The HT901 contract is load-bearing here.** Sequences join/leave every
+step, so naive shapes would retrace constantly. Instead every dispatch
+snaps to precomputed ladders — batch width to the power-of-two ladder
+(``session.py:next_bucket``), context length to a block-aligned ladder,
+prompt length to the decoder's prompt ladder — so distinct jit
+signatures are bounded by :attr:`compile_bound` =
+``|batch| x (|prompt| + |ctx|)`` ladder products no matter how churny
+the trace (the serving test measures exactly this).
+
+``reserve="full"`` (default) allocates a request's whole
+``prompt + max_new_tokens`` block budget at admission — no mid-decode
+exhaustion, ever. ``reserve="lazy"`` allocates blocks as positions are
+written (higher occupancy) and, on exhaustion, **preempts** the
+youngest running sequence: its blocks free, it requeues at the waiting
+head, and because sampling is keyed on ``(seed, token_index)`` the
+recompute reproduces the exact tokens it lost.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import itertools
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from ..models.gpt import (gpt_paged_prefill, gpt_paged_step,
+                          gpt_serving_params)
+from .kvcache import DEFAULT_BLOCK_SIZE, KVCacheExhausted, PagedKVCache
+from .router import SLOWindow
+from .session import next_bucket
+
+__all__ = ["ContinuousBatchingEngine", "EngineOverloaded"]
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission control shed this request: the waiting queue is full,
+    or ``admission="reject"`` and the KV pool can't hold it right now."""
+
+
+def _pow2_ladder(start, cap):
+    """Power-of-two ladder from ``start`` capped (and terminated) at
+    ``cap`` — the finite bucket set one dispatch dimension snaps to."""
+    ladder, b = [], max(1, int(start))
+    while b < cap:
+        ladder.append(b)
+        b *= 2
+    ladder.append(int(cap))
+    return tuple(ladder)
+
+
+def _choose_token(logits_row, temperature, seed, idx):
+    """Greedy or temperature sampling, host-side. Randomness is keyed
+    on ``(seed, token_index)`` — NOT on any global stream — so a
+    preempted sequence's recompute reproduces the tokens it already
+    produced."""
+    if temperature and temperature > 0.0:
+        z = logits_row.astype(np.float64) / float(temperature)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        rng = np.random.default_rng([int(seed) & 0xFFFFFFFF, int(idx)])
+        return int(rng.choice(len(p), p=p))
+    return int(np.argmax(logits_row))
+
+
+class _Seq:
+    __slots__ = ("id", "prompt", "max_new", "temperature", "seed",
+                 "future", "generated", "pending", "n_written",
+                 "t_submit", "preempts")
+
+    def __init__(self, sid, prompt, max_new, temperature, seed):
+        self.id = sid
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.future = Future()
+        self.generated = []     # chosen tokens, pending included
+        self.pending = None     # chosen but not yet written to the cache
+        self.n_written = 0      # cache rows written (prompt + decode)
+        self.t_submit = time.perf_counter()
+        self.preempts = 0
+
+
+class ContinuousBatchingEngine:
+    """See the module docstring. ``lookup(name) -> array`` resolves
+    checkpoint parameter names exactly as for
+    :class:`~hetu_tpu.serving.decode.GPTDecoder`; use the classmethods
+    for the common sources.
+
+    With ``start=True`` (default) a daemon scheduler thread drives
+    :meth:`step` whenever work exists; with ``start=False`` the caller
+    drives ``step()`` directly (deterministic tests) — never both.
+
+    ``submit()`` returns a Future resolving to the generated tokens as
+    a 1-D int32 array of length ``max_new_tokens``."""
+
+    def __init__(self, config, lookup, *, num_blocks=None,
+                 block_size=DEFAULT_BLOCK_SIZE, budget=None, max_len=None,
+                 max_batch_size=8, admission="queue", max_queue=256,
+                 reserve="full", slo_p99_ms=None, slo_error_rate=None,
+                 slo_window=128, telemetry=None, name="engine",
+                 start=True):
+        import jax
+        if admission not in ("queue", "reject"):
+            raise ValueError(f"admission must be 'queue' or 'reject', "
+                             f"got {admission!r}")
+        if reserve not in ("full", "lazy"):
+            raise ValueError(f"reserve must be 'full' or 'lazy', "
+                             f"got {reserve!r}")
+        self.config = config
+        self.max_len = int(max_len or config.max_position_embeddings)
+        if self.max_len > config.max_position_embeddings:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's learned "
+                f"positions ({config.max_position_embeddings})")
+        self.max_batch_size = int(max_batch_size)
+        self.admission = admission
+        self.max_queue = int(max_queue)
+        self.reserve = reserve
+        self.name = name
+        self.telemetry = _telemetry.resolve(telemetry)
+        self.slo = SLOWindow(slo_p99_ms, slo_error_rate, slo_window)
+        self.params = gpt_serving_params(config, lookup)
+        self.cache = PagedKVCache(config, num_blocks=num_blocks,
+                                  block_size=block_size, budget=budget,
+                                  telemetry=self.telemetry)
+        # HT901 ladders: every dispatch dimension snaps to one of these,
+        # so signatures stay bounded under per-step churn
+        self.batch_buckets = _pow2_ladder(1, self.max_batch_size)
+        self.prompt_buckets = _pow2_ladder(1, self.max_len)
+        self.ctx_buckets = _pow2_ladder(self.cache.block_size,
+                                        self.max_len)
+        nh = config.num_attention_heads
+        act = getattr(config, "hidden_act", "gelu")
+        self._prefill_fn = jax.jit(
+            functools.partial(gpt_paged_prefill, num_heads=nh,
+                              hidden_act=act), donate_argnums=(1,))
+        self._step_fn = jax.jit(
+            functools.partial(gpt_paged_step, num_heads=nh,
+                              hidden_act=act), donate_argnums=(1,))
+        self._signatures = set()
+        self._ids = itertools.count()
+        self._waiting = collections.deque()
+        self._running = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f"{name}-scheduler")
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_session(cls, session, config, **kw):
+        """From a live :class:`InferenceSession` over the same model
+        (shares the session's device-resident parameters)."""
+        params = session.params_by_name()
+        return cls(config, params.__getitem__, **kw)
+
+    @classmethod
+    def from_checkpoint(cls, config, path, **kw):
+        """From an ``Executor.save`` checkpoint directory."""
+        def lookup(name):
+            f = os.path.join(path, name + ".npy")
+            if not os.path.exists(f):
+                raise FileNotFoundError(
+                    f"checkpoint {path} has no parameter {name!r} "
+                    f"(expected {f})")
+            return np.load(f)
+        return cls(config, lookup, **kw)
+
+    # ------------------------------------------------------------------
+    @property
+    def compile_bound(self):
+        """The HT901 ladder-product bound on distinct jit signatures:
+        prefill keys on (batch, prompt) buckets, decode on (batch, ctx)
+        buckets — churn can never compile more programs than this."""
+        return len(self.batch_buckets) * (len(self.prompt_buckets)
+                                          + len(self.ctx_buckets))
+
+    @property
+    def jit_compiles(self):
+        """Distinct jit signatures dispatched so far (always <=
+        :attr:`compile_bound`; the serving test asserts it)."""
+        return len(self._signatures)
+
+    def health(self):
+        """(healthy, reason) under the configured SLOs — the same probe
+        contract as ``ServingHTTPServer.health`` / ``/healthz``, so the
+        replica router treats engines and HTTP replicas uniformly."""
+        return self.slo.health()
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, temperature=0.0, seed=0):
+        """Enqueue one request; returns a Future resolving to the
+        generated tokens (1-D int32, length ``max_new_tokens``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        if p < 1:
+            raise ValueError("submit() needs at least one prompt token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if p + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt {p} + {max_new_tokens} new tokens exceeds the "
+                f"engine's max_len {self.max_len}")
+        if not self.cache.fits_at_all(p + int(max_new_tokens)):
+            # no amount of queueing serves this: the pool is too small
+            raise KVCacheExhausted(
+                f"request of {p}+{max_new_tokens} tokens needs "
+                f"{self.cache.allocator.blocks_for_tokens(p + int(max_new_tokens))} "
+                f"blocks; the pool has {self.cache.num_blocks}")
+        seq = _Seq(next(self._ids), prompt, max_new_tokens, temperature,
+                   seed)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine closed")
+            if len(self._waiting) >= self.max_queue:
+                raise EngineOverloaded(
+                    f"waiting queue full ({self.max_queue} requests)")
+            self._waiting.append(seq)
+            self._set_depth_locked()
+            self._cond.notify()
+        return seq.future
+
+    def _set_depth_locked(self):
+        if self.telemetry.enabled:
+            self.telemetry.set_gauge(f"{self.name}_queue_depth",
+                                     len(self._waiting))
+
+    # ------------------------------------------------------------------
+    def step(self):
+        """One scheduler iteration (admit -> prefill -> decode ->
+        finish); returns the number of sequences still running."""
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        with self._cond:
+            admitted = self._admit_locked()
+        if not admitted and not self._running:
+            return 0
+        width = len(self._running)
+        cm = tel.span("step", subgraph="serving_engine") \
+            if tel.enabled else contextlib.nullcontext()
+        with cm:
+            if admitted:
+                self._prefill_admitted(admitted)
+            self._finish_done()
+            if self._running:
+                self._decode_once()
+                self._finish_done()
+        if tel.enabled:
+            tel.observe(f"{self.name}_step_ms",
+                        (time.perf_counter() - t0) * 1e3)
+            tel.observe(f"{self.name}_batch_width", width)
+        return len(self._running)
+
+    def _admit_locked(self):
+        admitted = []
+        while self._waiting and \
+                len(self._running) + len(admitted) < self.max_batch_size:
+            seq = self._waiting[0]
+            p = seq.prompt.shape[0]
+            reserve_tokens = p + (seq.max_new
+                                  if self.reserve == "full" else 0)
+            if not self.cache.can_admit(reserve_tokens):
+                if self.admission == "reject":
+                    self._waiting.popleft()
+                    seq.future.set_exception(EngineOverloaded(
+                        f"KV admission rejected request: "
+                        f"{self.cache.allocator.blocks_for_tokens(reserve_tokens)} "
+                        f"block(s) needed, "
+                        f"{self.cache.allocator.available} free"))
+                    continue
+                # queue policy: the FIFO head waits for blocks — later
+                # arrivals never jump it (no starvation)
+                break
+            self._waiting.popleft()
+            self.cache.add_seq(seq.id, reserve_tokens)
+            admitted.append(seq)
+        self._set_depth_locked()
+        self._running.extend(admitted)
+        return admitted
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, key, fn, *args):
+        """Run one jit program, accounting compiles the way the
+        executor does (HT901's runtime half): first sighting of a
+        signature key incs ``jit_compiles`` under a ``jit_compile``
+        span, steady-state dispatches ride ``device_dispatch``."""
+        tel = self.telemetry
+        if key not in self._signatures:
+            self._signatures.add(key)
+            if tel.enabled:
+                with tel.span("jit_compile", subgraph="serving_engine",
+                              shape_key=str(key)):
+                    out = fn(*args)
+                tel.inc("jit_compiles")
+                return out
+            return fn(*args)
+        if tel.enabled:
+            with tel.span("device_dispatch", subgraph="serving_engine"):
+                return fn(*args)
+        return fn(*args)
+
+    def _prefill_admitted(self, admitted):
+        import jax.numpy as jnp
+        tel = self.telemetry
+        groups = {}
+        for s in admitted:
+            pb = next_bucket(s.prompt.shape[0], self.prompt_buckets)
+            groups.setdefault(pb, []).append(s)
+        for pb, group in sorted(groups.items()):
+            bb = next_bucket(len(group), self.batch_buckets)
+            ids = np.zeros((bb, pb), np.int32)
+            slots = np.zeros((bb, pb), np.int32)   # 0 = scratch block
+            for i, s in enumerate(group):
+                p = s.prompt.shape[0]
+                ids[i, :p] = s.prompt
+                ids[i, p:] = s.prompt[-1]   # edge pad stays in-vocab
+                slots[i, :p] = self.cache.slot_mapping(s.id, 0, p)
+            logits, pools = self._dispatch(
+                ("prefill", bb, pb), self._prefill_fn, self.params,
+                self.cache.pools, jnp.asarray(ids), jnp.asarray(slots))
+            self.cache.pools = pools
+            last = np.asarray(
+                logits[jnp.arange(len(group)),
+                       jnp.asarray([s.prompt.shape[0] - 1
+                                    for s in group])])
+            for i, s in enumerate(group):
+                p = s.prompt.shape[0]
+                tok = _choose_token(last[i], s.temperature, s.seed, 0)
+                s.generated.append(tok)
+                s.pending = tok
+                s.n_written = p
+            if tel.enabled:
+                real = sum(s.prompt.shape[0] for s in group)
+                tel.inc(f"{self.name}_prefill_tokens", real)
+                tel.inc(f"{self.name}_prefill_pad_tokens",
+                        bb * pb - real)
+                tel.inc(f"{self.name}_tokens", len(group))
+
+    def _ensure_capacity_lazy(self, active):
+        """Lazy-reserve growth: make every active sequence's table
+        cover its write position, preempting the youngest running
+        sequence on exhaustion. Returns the surviving active list."""
+        for s in list(active):
+            if s not in self._running:
+                continue            # already preempted as a victim
+            while s.n_written + 1 > self.cache.capacity_tokens(s.id):
+                try:
+                    self.cache.extend_seq(s.id, s.n_written + 1)
+                except KVCacheExhausted:
+                    victim = self._running[-1]
+                    self._preempt(victim)
+                    if victim is s:
+                        break
+        return [s for s in active if s in self._running]
+
+    def _preempt(self, victim):
+        """Free a sequence's blocks and requeue it at the waiting head;
+        recompute reproduces its tokens ((seed, index)-keyed
+        sampling)."""
+        self.cache.free_seq(victim.id)
+        victim.generated = []
+        victim.pending = None
+        victim.n_written = 0
+        victim.preempts += 1
+        with self._cond:
+            self._running.remove(victim)
+            self._waiting.appendleft(victim)
+            self._set_depth_locked()
+        if self.telemetry.enabled:
+            self.telemetry.inc(f"{self.name}_preemptions")
+
+    def _decode_once(self):
+        import jax.numpy as jnp
+        active = [s for s in self._running
+                  if len(s.generated) < s.max_new]
+        if self.reserve == "lazy":
+            active = self._ensure_capacity_lazy(active)
+        if not active:
+            return
+        bb = next_bucket(len(active), self.batch_buckets)
+        cb = next_bucket(max(s.n_written for s in active) + 1,
+                         self.ctx_buckets)
+        tokens = np.zeros(bb, np.int32)
+        positions = np.zeros(bb, np.int32)
+        write_slots = np.zeros(bb, np.int32)       # 0 = scratch block
+        slot_grid = np.zeros((bb, cb), np.int32)
+        slot_grid[:len(active)] = self.cache.gather_slots(
+            [s.id for s in active], cb)
+        for i, s in enumerate(active):
+            tokens[i] = s.pending
+            positions[i] = s.n_written
+            write_slots[i] = self.cache.slot_of(s.id, s.n_written)
+        logits, pools = self._dispatch(
+            ("decode", bb, cb), self._step_fn, self.params,
+            self.cache.pools, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(slot_grid),
+            jnp.asarray(write_slots))
+        self.cache.pools = pools
+        last = np.asarray(logits[:len(active)])
+        for i, s in enumerate(active):
+            s.n_written += 1
+            tok = _choose_token(last[i], s.temperature, s.seed,
+                                len(s.generated))
+            s.generated.append(tok)
+            s.pending = tok
+        if self.telemetry.enabled:
+            self.telemetry.inc(f"{self.name}_tokens", len(active))
+
+    def _finish_done(self):
+        tel = self.telemetry
+        with self._cond:
+            done = [s for s in self._running
+                    if len(s.generated) >= s.max_new]
+            for s in done:
+                self._running.remove(s)
+        for s in done:
+            self.cache.free_seq(s.id)
+            ms = (time.perf_counter() - s.t_submit) * 1e3
+            self.slo.note(True, ms)
+            if tel.enabled:
+                tel.observe(f"{self.name}_latency_ms", ms)
+                tel.inc(f"{self.name}_requests")
+            s.future.set_result(
+                np.asarray(s.generated[:s.max_new], np.int32))
+
+    # ------------------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while not self._closed and not self._waiting \
+                            and not self._running:
+                        self._cond.wait()
+                    if self._closed and not self._waiting \
+                            and not self._running:
+                        return
+                    if self._closed:
+                        break       # drain what's in flight, then fail
+                self.step()
+        except BaseException as e:  # noqa: BLE001 — scheduler died
+            self._fail_outstanding(
+                RuntimeError(f"engine scheduler died: {e!r}"))
+            raise
+        # closed with work outstanding: fail it rather than hang callers
+        self._fail_outstanding(RuntimeError("engine closed"))
+
+    def _fail_outstanding(self, exc):
+        with self._cond:
+            self._closed = True
+            leftovers = list(self._waiting) + list(self._running)
+            self._waiting.clear()
+            self._running.clear()
+            self._cond.notify_all()
+        for s in leftovers:
+            self.cache.free_seq(s.id)
+            if not s.future.done():
+                s.future.set_exception(exc)
+
+    def close(self):
+        """Stop the scheduler; outstanding Futures fail with
+        "engine closed". Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._fail_outstanding(RuntimeError("engine closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -32,9 +32,17 @@ _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 # — HIGHER is better; ingest_wait_ms is device-waited-on-host — lower.
 # bubble_fraction is the pipeline's analytic idle share (pipeline.py)
 # — lower; autoplan_vs_hand is the planner's throughput ratio against
-# the best hand config (parallel/autoplan.py) — higher.
+# the best hand config (parallel/autoplan.py) — higher. serve_p99_ms is
+# the continuous-batching bench's closed-loop request tail latency
+# (bench_serving_continuous) — lower; kv_hbm_utilization is its peak
+# paged-pool occupancy (serving/kvcache.py) — higher means the blocks
+# provisioned against the HBM budget actually carry traffic.
+# (serving_tokens_per_sec_per_chip needs no entry: it's a metric of its
+# own and "tokens...": the unit heuristic already reads it higher-is-
+# better.)
 _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
-                    "bubble_fraction": True, "autoplan_vs_hand": False}
+                    "bubble_fraction": True, "autoplan_vs_hand": False,
+                    "serve_p99_ms": True, "kv_hbm_utilization": False}
 
 # informational per-record fields: the health monitor's stamps
 # (telemetry/health.py — a loss_finite flip is a broken run to

@@ -32,6 +32,21 @@ def _seed():
     np.random.seed(0)
 
 
+@pytest.fixture(autouse=True)
+def _no_telemetry_default_leak():
+    """telemetry.resolve() promotes any ENABLED Telemetry instance to
+    the process-global default (deliberate in production: config-less
+    components attribute into the same trace). Between tests it is
+    leakage — a test passing telemetry=Telemetry(enabled=True) into any
+    component would silently flip every LATER test's executors onto the
+    telemetry-on code paths (AOT compile, spans, atexit flushes), making
+    the suite order-dependent. Restore the default around every test."""
+    from hetu_tpu import telemetry as _tmod
+    before = _tmod._default
+    yield
+    _tmod._default = before
+
+
 # ---------------------------------------------------------------------------
 # thread hygiene (ISSUE 12): a test that leaks a live non-daemon thread
 # fails — leaked threads outlive the test, hang interpreter exit, and

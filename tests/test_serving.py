@@ -246,6 +246,23 @@ def test_kv_decode_respects_hidden_act():
                                atol=1e-5)
 
 
+def test_prefill_counters_split_real_from_pad_tokens():
+    """decode_prefill_tokens counts only REAL prompt tokens; bucket
+    padding lands in decode_prefill_pad_tokens — the overcount that
+    used to inflate the prefill-throughput stamp."""
+    tel = _tel()
+    cfg, ids, sess = _gpt_session(seed=7)
+    dec = GPTDecoder.from_session(sess, cfg, telemetry=tel)
+    x = np.random.RandomState(7).randint(0, VOCAB, (2, 5))
+    dec.generate(x, 2)                  # prompt 5 -> bucket 8 per row
+    assert tel.counter_value("decode_prefill_tokens") == 2 * 5
+    assert tel.counter_value("decode_prefill_pad_tokens") == 2 * 3
+    # a direct exact-shape prefill is all real tokens, no pad
+    dec.prefill(x)
+    assert tel.counter_value("decode_prefill_tokens") == 2 * 5 + 2 * 5
+    assert tel.counter_value("decode_prefill_pad_tokens") == 2 * 3
+
+
 def test_decoder_from_checkpoint(tmp_path):
     cfg, ids, sess = _gpt_session(seed=3)
     sess.executor.save(str(tmp_path))
@@ -342,6 +359,43 @@ def test_batcher_propagates_errors_and_rejects_after_close():
     mb.close()
     with pytest.raises(RuntimeError, match="closed"):
         mb.submit({"x": np.zeros((1, 2))})
+
+
+def test_batcher_splits_oversized_request_into_one_future():
+    """A request wider than max_batch_size is split server-side into
+    adjacent chunks; the caller still holds ONE future whose result is
+    the row-ordered stitch of every chunk."""
+    tel = _tel()
+    x, out, _ = _linear_graph(seed=9)
+    sess = InferenceSession([out], telemetry=tel)
+    w = np.asarray(sess.params_by_name()["w"])
+    calls = []
+
+    def serve(feeds):
+        calls.append(feeds["x"].shape[0])
+        return sess.predict(feeds)
+
+    rng = np.random.RandomState(9)
+    rows = rng.randn(10, 20).astype("f")
+    with MicroBatcher(serve, max_batch_size=4, max_wait_ms=5,
+                      telemetry=tel) as mb:
+        got = mb.submit({"x": rows}).result(30)[0]
+    np.testing.assert_allclose(got, rows @ w, rtol=1e-5, atol=1e-5)
+    assert max(calls) <= 4, f"a chunk exceeded max_batch_size: {calls}"
+    assert sum(calls) == 10
+    assert tel.counter_value("serve_split_requests") == 1
+    # a chunk failure fails the ONE future, with the chunk's error
+    attempts = []
+
+    def flaky(feeds):
+        attempts.append(feeds["x"].shape[0])
+        if len(attempts) >= 2:
+            raise RuntimeError("chunk 2 kaboom")
+        return feeds["x"] * 2.0
+
+    with MicroBatcher(flaky, max_batch_size=4, max_wait_ms=5) as mb:
+        with pytest.raises(RuntimeError, match="kaboom"):
+            mb.submit({"x": rows}).result(30)
 
 
 # ---------------------------------------------------------------------------
