@@ -1143,7 +1143,9 @@ def bench_serving_continuous():
     systems fully warmed by one untimed pre-run. The claimed tokens/sec
     is perfcheck-gated against the engine's own token counters
     (``analysis/perfcheck.py:serving_claim_check``) — attributed, not
-    asserted."""
+    asserted — and every timed request's lifecycle timeline must pass
+    the serving doctor's conservation check before the TTFT/TPOT/queue
+    percentiles are stamped."""
     import threading
 
     import jax
@@ -1266,6 +1268,9 @@ def bench_serving_continuous():
     run_clients(engine_one)
     run_clients(engine_one)
     engine.cache.peak_utilization = 0.0             # stamp = timed peak
+    # discard the warm passes' request timelines: the per-request
+    # attribution below must see ONLY the timed window's serve_* spans
+    tel.tracer.drain(clear=True)
     c0 = tel.counter_value("engine_tokens")
     wall, lat = run_clients(engine_one)
     counted = tel.counter_value("engine_tokens") - c0
@@ -1279,6 +1284,21 @@ def bench_serving_continuous():
             f"serving_claim_check failed: claimed {tps:.1f} tok/s vs "
             f"counter-measured {measured_tps:.1f} tok/s over {wall:.2f}s "
             f"({counted} counted vs {total_tokens} requested tokens)")
+
+    # request-level attribution gate (serving/lifecycle.py + the serving
+    # doctor): every timed request must have a COMPLETE timeline whose
+    # queue/prefill/decode/replay/overhead buckets sum to its measured
+    # e2e — conservation checked, not hoped
+    from hetu_tpu.telemetry.doctor import attribute_request_events
+    rattr = attribute_request_events(tel.tracer.drain())
+    if rattr.get("requests") != nclients * per_client \
+            or not rattr.get("conserved") or not rattr.get("complete"):
+        raise RuntimeError(
+            f"serving attribution gate failed: "
+            f"{rattr.get('requests')}/{nclients * per_client} requests "
+            f"attributed, conserved={rattr.get('conserved')} "
+            f"complete={rattr.get('complete')}; first violations: "
+            f"{(rattr.get('violations') or rattr.get('incomplete'))[:3]}")
 
     snap = {s["name"]: s for s in tel.metrics.snapshot()}
     step_hist = snap.get("engine_step_ms", {})
@@ -1295,6 +1315,12 @@ def bench_serving_continuous():
          engine_jit_compiles=engine.jit_compiles,
          engine_compile_bound=engine.compile_bound,
          requests=nclients * per_client, clients=nclients,
+         serve_ttft_p99_ms=round(float(rattr["serve_ttft_p99_ms"]), 2),
+         serve_tpot_p50_ms=round(float(rattr["serve_tpot_p50_ms"]), 3),
+         serve_queue_wait_p99_ms=round(
+             float(rattr["serve_queue_wait_p99_ms"]), 2),
+         preempt_rate=round(float(rattr["preempt_rate"]), 4),
+         replay_fraction=round(float(rattr["replay_fraction"]), 4),
          h2d_MBps=h2d_probe_mbps(),
          step_ms_p50=round(float(step_hist.get("p50", 0.0)), 3),
          step_ms_p95=round(float(step_hist.get("p95", 0.0)), 3))

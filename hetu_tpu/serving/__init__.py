@@ -32,6 +32,14 @@ checkpoints and sparse tables; serving composes them into four pieces:
   bucketed jit signatures, KV-block admission control), and
   :class:`~hetu_tpu.serving.router.ReplicaRouter` (SLO-probed
   least-inflight routing + load shedding over N replicas).
+* :mod:`~hetu_tpu.serving.lifecycle` — request-level observability:
+  end-to-end request ids minted at ingress and propagated through
+  router/engine/batcher, per-request phase timelines exported as
+  ``serve_request``/``serve_phase`` trace spans (the serving doctor's
+  input: ``python -m hetu_tpu.telemetry.doctor --serving``), live
+  ``inflight_requests()``/``stats()`` introspection behind
+  ``GET /v1/requests`` and ``GET /stats``, and crash-time
+  ``requests_rank<r>.json`` dumps the black-box analyzer ingests.
 """
 from .session import InferenceSession, next_bucket
 from .batcher import MicroBatcher
@@ -39,6 +47,7 @@ from .decode import GPTDecoder
 from .embedding import ReadOnlyPSClient, serve_embeddings_from_ps
 from .http import ServingHTTPServer
 from .kvcache import BlockAllocator, KVCacheExhausted, PagedKVCache
+from .lifecycle import RequestTimeline, mint_request_id
 from .router import ReplicaRouter, RouterOverloaded, SLOWindow
 from .scheduler import ContinuousBatchingEngine, EngineOverloaded
 
@@ -47,4 +56,5 @@ __all__ = ["InferenceSession", "MicroBatcher", "GPTDecoder",
            "ServingHTTPServer", "next_bucket",
            "BlockAllocator", "KVCacheExhausted", "PagedKVCache",
            "ContinuousBatchingEngine", "EngineOverloaded",
-           "ReplicaRouter", "RouterOverloaded", "SLOWindow"]
+           "ReplicaRouter", "RouterOverloaded", "SLOWindow",
+           "RequestTimeline", "mint_request_id"]

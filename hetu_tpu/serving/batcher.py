@@ -11,7 +11,10 @@ server-side into adjacent chunks that resolve through one Future.
 Telemetry (through ``hetu_tpu/telemetry/metrics.py``): ``<name>_queue_depth``
 gauge, ``<name>_latency_ms`` p50/p95/p99 histogram (submit -> result),
 ``<name>_batch_size`` / ``<name>_batch_occupancy`` histograms, and
-``<name>_requests`` / ``<name>_batches`` counters.
+``<name>_requests`` / ``<name>_batches`` counters — plus the
+fleet-level ``serve_queue_wait_ms`` histogram (submit -> tick claim),
+the same bucket the continuous-batching engine records, so the serving
+A/B compares queue wait like-for-like.
 """
 from __future__ import annotations
 
@@ -24,6 +27,8 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import telemetry as _telemetry
+from . import lifecycle as _lifecycle
+from .lifecycle import mint_request_id
 
 __all__ = ["MicroBatcher"]
 
@@ -48,13 +53,14 @@ def _stitch_chunks(results, n):
 
 
 class _Request:
-    __slots__ = ("feeds", "n", "future", "t_submit")
+    __slots__ = ("feeds", "n", "future", "t_submit", "rid")
 
-    def __init__(self, feeds, n, future):
+    def __init__(self, feeds, n, future, rid):
         self.feeds = feeds
         self.n = n
         self.future = future
         self.t_submit = time.perf_counter()
+        self.rid = rid
 
 
 class MicroBatcher:
@@ -77,26 +83,30 @@ class MicroBatcher:
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"{name}-batcher")
+        _lifecycle.register(self)   # crash-time in-flight dumps
         self._thread.start()
 
     # ------------------------------------------------------------------
-    def submit(self, feeds):
+    def submit(self, feeds, request_id=None):
         """Enqueue one request (each value ``[n, ...]``); returns a
         Future resolving to ``serve_fn``'s output sliced to this
-        request's rows."""
+        request's rows. ``request_id`` is the end-to-end tracing id
+        (minted here when the caller didn't supply one)."""
         arrays = {k: np.asarray(v) for k, v in feeds.items()}
         sizes = {v.shape[0] for v in arrays.values() if v.ndim}
         if len(sizes) != 1:
             raise ValueError(
                 f"request feeds disagree on batch size: {sorted(sizes)}")
         n = sizes.pop()
+        rid = str(request_id) if request_id is not None \
+            else mint_request_id()
         if n > self.max_batch_size:
             # oversized requests split server-side across ticks: the
             # chunks enqueue adjacently (FIFO keeps row order), and ONE
             # combining Future stitches the per-chunk outputs back in
             # request row order
-            return self._submit_split(arrays, n)
-        req = _Request(arrays, n, Future())
+            return self._submit_split(arrays, n, rid)
+        req = _Request(arrays, n, Future(), rid)
         with self._cond:
             # submit/close race contract (pinned by the racecheck
             # stress test): a submit that wins the lock before close()
@@ -110,18 +120,20 @@ class MicroBatcher:
             self._cond.notify()
         return req.future
 
-    def _submit_split(self, arrays, n):
+    def _submit_split(self, arrays, n, rid):
         """Split an ``n > max_batch_size`` request into consecutive
         chunks enqueued atomically (they stay adjacent in the FIFO, so
         the rows come back in submission order even when they land in
         different ticks) and return ONE Future resolving to the stitched
-        outputs. The first chunk failure fails the whole request."""
+        outputs. The first chunk failure fails the whole request; every
+        chunk carries the parent's request id."""
         size = self.max_batch_size
         chunks = []
         for off in range(0, n, size):
             sub = {k: (v[off:off + size] if v.ndim else v)
                    for k, v in arrays.items()}
-            chunks.append(_Request(sub, min(size, n - off), Future()))
+            chunks.append(_Request(sub, min(size, n - off), Future(),
+                                   rid))
         combined = Future()
         state_lock = threading.Lock()
         pending = [len(chunks)]
@@ -232,6 +244,11 @@ class MicroBatcher:
             raise
 
     def _serve(self, batch):
+        # queue wait ends when the tick claims the batch — measured
+        # before serve_fn so it carries coalescing/straggler wait only,
+        # the same serve_queue_wait_ms bucket the engine records (the
+        # serving A/B compares like-for-like)
+        t_claim = time.perf_counter()
         # the WHOLE tick is guarded: a malformed request (ragged trailing
         # dims, mismatched feed keys) must fail that tick's futures, not
         # kill the batcher thread and strand every later submit
@@ -261,6 +278,8 @@ class MicroBatcher:
                 if tel.enabled:
                     tel.observe(f"{self.name}_latency_ms",
                                 (now - r.t_submit) * 1e3)
+                    tel.observe("serve_queue_wait_ms",
+                                (t_claim - r.t_submit) * 1e3)
         except Exception as e:                          # noqa: BLE001
             for r in batch:
                 if not r.future.done():
@@ -272,6 +291,27 @@ class MicroBatcher:
             tel.observe(f"{self.name}_batch_size", total)
             tel.observe(f"{self.name}_batch_occupancy",
                         total / self.max_batch_size)
+
+    # ------------------------------------------------------------------
+    def inflight_requests(self):
+        """Live in-flight table (``GET /v1/requests`` and the
+        crash-dump ``requests_rank<r>.json``): queued requests with id,
+        row count, and age."""
+        now = time.perf_counter()
+        with self._cond:
+            snap = list(self._queue)
+        return [{"request_id": r.rid, "phase": "waiting", "rows": r.n,
+                 "age_ms": round((now - r.t_submit) * 1e3, 3)}
+                for r in snap]
+
+    def stats(self):
+        """One batcher snapshot for ``GET /stats``."""
+        with self._cond:
+            waiting = len(self._queue)
+        return {"name": self.name, "kind": "MicroBatcher",
+                "waiting": waiting,
+                "max_batch_size": self.max_batch_size,
+                "max_wait_ms": self.max_wait * 1e3}
 
     # ------------------------------------------------------------------
     def close(self):

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import numpy as np
 
@@ -141,6 +142,8 @@ class GPTDecoder:
                 f"prompt {p} + {max_new_tokens} new tokens exceeds the "
                 f"decoder's max_len {self.max_len}")
         key = jax.random.PRNGKey(seed)
+        tel = self.telemetry
+        t_start = time.perf_counter() if tel.enabled else 0.0
         out = []            # device arrays; ONE host transfer at the end
         # prompt-length bucketing: prefill compiles once per (batch,
         # bucket), not once per exact length. The padded tail writes
@@ -156,6 +159,14 @@ class GPTDecoder:
         else:
             logits, kv = self.prefill(prompts)
         last = logits[:, p - 1]
+        if tel.enabled:
+            # the same fleet-level TTFT histogram the continuous-
+            # batching engine records, so the serving A/B compares
+            # like-for-like; the block is the price of an honest
+            # measurement under async dispatch (telemetry-on only)
+            jax.block_until_ready(last)
+            t_first = time.perf_counter()
+            tel.observe("serve_ttft_ms", (t_first - t_start) * 1e3)
         for t in range(max_new_tokens):
             if temperature and temperature > 0.0:
                 tok = jax.random.categorical(
@@ -167,9 +178,14 @@ class GPTDecoder:
             out.append(tok)     # stays on device: no per-token sync
             if t + 1 < max_new_tokens:
                 last, kv = self.decode_step(kv, tok, p + t)
-        if self.telemetry.enabled:
-            self.telemetry.inc("decode_tokens", b * max_new_tokens)
+        if tel.enabled:
+            tel.inc("decode_tokens", b * max_new_tokens)
         gen = np.asarray(jnp.stack(out, axis=1))
+        if tel.enabled:
+            # host transfer above is the sync: all decode steps are done
+            tel.observe("serve_tpot_ms",
+                        (time.perf_counter() - t_first) * 1e3
+                        / max(1, max_new_tokens - 1))
         if return_prompt:
             return np.concatenate([prompts, gen], axis=1)
         return gen

@@ -225,6 +225,9 @@ class PagedKVCache:
         if self.telemetry.enabled:
             self.telemetry.set_gauge("kv_blocks_used",
                                      self.allocator.used)
+            self.telemetry.set_gauge("kv_blocks_free",
+                                     self.allocator.available)
+            self.telemetry.set_gauge("kv_seqs", len(self.tables))
             self.telemetry.set_gauge("kv_hbm_utilization", u)
 
     # -- sequence lifecycle ---------------------------------------------

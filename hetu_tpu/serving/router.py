@@ -31,6 +31,7 @@ from collections import deque
 import numpy as np
 
 from .. import telemetry as _telemetry
+from . import lifecycle as _lifecycle
 
 __all__ = ["SLOWindow", "ReplicaRouter", "RouterOverloaded"]
 
@@ -43,44 +44,64 @@ class RouterOverloaded(RuntimeError):
 class SLOWindow:
     """Rolling window of request outcomes + the SLO breach verdict.
 
-    ``note(ok, ms)`` records one request; ``health()`` returns
-    ``(healthy, reason)`` — healthy whenever no SLO is configured or
-    the window is empty, breached when the windowed error rate exceeds
-    ``error_rate`` or the windowed p99 of successful-request latency
-    exceeds ``p99_ms``. Thread-safe."""
+    ``note(ok, ms, ttft_ms=None)`` records one request; ``health()``
+    returns ``(healthy, reason)`` — healthy whenever no SLO is
+    configured or the window is empty, breached when the windowed error
+    rate exceeds ``error_rate``, the windowed p99 of
+    successful-request latency exceeds ``p99_ms``, or the windowed p99
+    of time-to-first-token exceeds ``ttft_p99_ms`` (the
+    streaming-experience SLO: a request can meet its e2e budget while
+    its first token arrived unacceptably late). TTFT is recorded by
+    producers that know it (the continuous-batching engine, when
+    telemetry is on); requests noted without one simply don't count
+    toward the TTFT percentile. Thread-safe."""
 
-    def __init__(self, p99_ms=None, error_rate=None, window=128):
+    def __init__(self, p99_ms=None, error_rate=None, window=128,
+                 ttft_p99_ms=None):
         self.p99_ms = p99_ms
         self.error_rate = error_rate
-        self._window = deque(maxlen=int(window))    # (ok, latency_ms)
+        self.ttft_p99_ms = ttft_p99_ms
+        # (ok, latency_ms, ttft_ms-or-None)
+        self._window = deque(maxlen=int(window))
         self._lock = threading.Lock()
 
-    def note(self, ok, ms):
+    def note(self, ok, ms, ttft_ms=None):
         with self._lock:
-            self._window.append((bool(ok), float(ms)))
+            self._window.append(
+                (bool(ok), float(ms),
+                 None if ttft_ms is None else float(ttft_ms)))
 
     def health(self):
         """(healthy, reason) under the configured SLOs."""
-        if self.p99_ms is None and self.error_rate is None:
+        if self.p99_ms is None and self.error_rate is None \
+                and self.ttft_p99_ms is None:
             return True, "ok"
         with self._lock:
             window = list(self._window)
         if not window:
             return True, "ok (no traffic)"
         if self.error_rate is not None:
-            rate = sum(1 for ok, _ in window if not ok) / len(window)
+            rate = sum(1 for ok, _, _ in window if not ok) / len(window)
             if rate > self.error_rate:
                 return False, (f"error rate {rate:.3f} > SLO "
                                f"{self.error_rate:.3f} over "
                                f"{len(window)} requests")
         if self.p99_ms is not None:
-            lats = [ms for ok, ms in window if ok]
+            lats = [ms for ok, ms, _ in window if ok]
             if lats:
                 p99 = float(np.percentile(lats, 99))
                 if p99 > self.p99_ms:
                     return False, (f"serve_latency_ms p99 {p99:.1f} > "
                                    f"SLO {self.p99_ms:.1f} over "
                                    f"{len(lats)} requests")
+        if self.ttft_p99_ms is not None:
+            ttfts = [t for ok, _, t in window if ok and t is not None]
+            if ttfts:
+                p99 = float(np.percentile(ttfts, 99))
+                if p99 > self.ttft_p99_ms:
+                    return False, (f"serve_ttft_ms p99 {p99:.1f} > "
+                                   f"SLO {self.ttft_p99_ms:.1f} over "
+                                   f"{len(ttfts)} requests")
         return True, "ok"
 
 
@@ -115,10 +136,49 @@ class ReplicaRouter:
             _ReplicaState(r, SLOWindow(slo_p99_ms, slo_error_rate,
                                        slo_window))
             for r in replicas]
+        _lifecycle.register(self)   # crash-time in-flight dumps
 
     @property
     def replicas(self):
         return [s.replica for s in self._states]
+
+    def stats(self):
+        """Per-replica routing snapshot for ``GET /stats``: inflight /
+        routed counts and the breach verdict, plus each replica's own
+        ``stats()`` when it has one."""
+        out = []
+        for i, s in enumerate(self._states):
+            ok, reason = s.health()
+            entry = {"index": i, "inflight": s.inflight,
+                     "routed": s.routed, "healthy": ok,
+                     "reason": reason}
+            sub = getattr(s.replica, "stats", None)
+            if callable(sub):
+                try:
+                    entry["replica"] = sub()
+                except Exception:   # noqa: BLE001 — introspection only
+                    pass
+            out.append(entry)
+        return {"name": self.name, "kind": "ReplicaRouter",
+                "replicas": out}
+
+    def inflight_requests(self):
+        """Fleet in-flight table: the union of every replica's
+        ``inflight_requests()``, each row tagged with its replica
+        index."""
+        rows = []
+        for i, s in enumerate(self._states):
+            fn = getattr(s.replica, "inflight_requests", None)
+            if not callable(fn):
+                continue
+            try:
+                for row in fn():
+                    row = dict(row)
+                    row["replica"] = i
+                    rows.append(row)
+            except Exception:       # noqa: BLE001 — introspection only
+                continue
+        return rows
 
     def health(self):
         """(healthy, reason): healthy while ANY replica is."""
@@ -148,7 +208,9 @@ class ReplicaRouter:
 
     def submit(self, *args, **kwargs):
         """Route one request; returns the replica's Future. Raises
-        :class:`RouterOverloaded` when every replica is breached."""
+        :class:`RouterOverloaded` when every replica is breached.
+        All arguments (``request_id=`` included) pass through to the
+        chosen replica, so end-to-end tracing survives the hop."""
         i, state = self._pick()
         tel = self.telemetry
         if tel.enabled:

@@ -60,7 +60,9 @@ import numpy as np
 from .. import telemetry as _telemetry
 from ..models.gpt import (gpt_paged_prefill, gpt_paged_step,
                           gpt_serving_params)
+from . import lifecycle as _lifecycle
 from .kvcache import DEFAULT_BLOCK_SIZE, KVCacheExhausted, PagedKVCache
+from .lifecycle import RequestTimeline, mint_request_id
 from .router import SLOWindow
 from .session import next_bucket
 
@@ -101,9 +103,10 @@ def _choose_token(logits_row, temperature, seed, idx):
 class _Seq:
     __slots__ = ("id", "prompt", "max_new", "temperature", "seed",
                  "future", "generated", "pending", "n_written",
-                 "t_submit", "preempts")
+                 "t_submit", "preempts", "rid", "tl", "tokens_lost")
 
-    def __init__(self, sid, prompt, max_new, temperature, seed):
+    def __init__(self, sid, prompt, max_new, temperature, seed, rid,
+                 tl):
         self.id = sid
         self.prompt = prompt
         self.max_new = int(max_new)
@@ -115,6 +118,16 @@ class _Seq:
         self.n_written = 0      # cache rows written (prompt + decode)
         self.t_submit = time.perf_counter()
         self.preempts = 0
+        self.rid = rid          # request id (caller-supplied or minted)
+        self.tl = tl            # RequestTimeline, None when tel disabled
+        # tokens the last preemption threw away; while
+        # len(generated) <= tokens_lost the sequence is re-earning them
+        # (its episodes are "replay", and live introspection says so)
+        self.tokens_lost = 0
+
+    def replaying(self):
+        return self.tokens_lost > 0 and \
+            len(self.generated) <= self.tokens_lost
 
 
 class ContinuousBatchingEngine:
@@ -134,8 +147,8 @@ class ContinuousBatchingEngine:
                  block_size=DEFAULT_BLOCK_SIZE, budget=None, max_len=None,
                  max_batch_size=8, admission="queue", max_queue=256,
                  reserve="full", slo_p99_ms=None, slo_error_rate=None,
-                 slo_window=128, telemetry=None, name="engine",
-                 start=True):
+                 slo_window=128, slo_ttft_p99_ms=None, telemetry=None,
+                 name="engine", start=True):
         import jax
         if admission not in ("queue", "reject"):
             raise ValueError(f"admission must be 'queue' or 'reject', "
@@ -155,7 +168,8 @@ class ContinuousBatchingEngine:
         self.reserve = reserve
         self.name = name
         self.telemetry = _telemetry.resolve(telemetry)
-        self.slo = SLOWindow(slo_p99_ms, slo_error_rate, slo_window)
+        self.slo = SLOWindow(slo_p99_ms, slo_error_rate, slo_window,
+                             ttft_p99_ms=slo_ttft_p99_ms)
         self.params = gpt_serving_params(config, lookup)
         self.cache = PagedKVCache(config, num_blocks=num_blocks,
                                   block_size=block_size, budget=budget,
@@ -181,6 +195,7 @@ class ContinuousBatchingEngine:
         self._cond = threading.Condition()
         self._closed = False
         self._thread = None
+        _lifecycle.register(self)   # crash-time in-flight dumps
         if start:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True, name=f"{name}-scheduler")
@@ -227,10 +242,59 @@ class ContinuousBatchingEngine:
         replica router treats engines and HTTP replicas uniformly."""
         return self.slo.health()
 
+    def inflight_requests(self):
+        """Live in-flight table (``GET /v1/requests`` and the
+        crash-dump ``requests_rank<r>.json``): one row per waiting or
+        running request — id, phase (waiting / preempted / running /
+        replay), tokens done vs budget, KV blocks held, preemption
+        count, age. Works with telemetry disabled."""
+        now = time.perf_counter()
+        with self._cond:
+            snap = [(s, "waiting" if s.preempts == 0 else "preempted")
+                    for s in self._waiting]
+            snap += [(s, "replay" if s.replaying() else "running")
+                     for s in self._running]
+        tables = self.cache.tables
+        return [{"request_id": s.rid,
+                 "phase": phase,
+                 "tokens_done": len(s.generated),
+                 "tokens_budget": s.max_new,
+                 "kv_blocks": len(tables.get(s.id, ())),
+                 "preempts": s.preempts,
+                 "age_ms": round((now - s.t_submit) * 1e3, 3)}
+                for s, phase in snap]
+
+    def stats(self):
+        """One engine snapshot for ``GET /stats``: queue depths, KV
+        pressure, HT901 compile accounting, SLO verdict."""
+        with self._cond:
+            running, waiting = len(self._running), len(self._waiting)
+        healthy, reason = self.health()
+        return {"name": self.name,
+                "kind": "ContinuousBatchingEngine",
+                "running": running,
+                "waiting": waiting,
+                "max_batch_size": self.max_batch_size,
+                "admission": self.admission,
+                "reserve": self.reserve,
+                "kv_blocks": self.cache.num_blocks,
+                "kv_blocks_used": self.cache.used_blocks,
+                "kv_hbm_utilization": round(self.cache.utilization, 4),
+                "jit_compiles": self.jit_compiles,
+                "compile_bound": self.compile_bound,
+                "healthy": healthy,
+                "health_reason": reason}
+
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens, temperature=0.0, seed=0):
+    def submit(self, prompt, max_new_tokens, temperature=0.0, seed=0,
+               request_id=None):
         """Enqueue one request; returns a Future resolving to the
-        generated tokens (1-D int32, length ``max_new_tokens``)."""
+        generated tokens (1-D int32, length ``max_new_tokens``).
+
+        ``request_id`` is the end-to-end tracing id (minted here when
+        the caller — HTTP ingress, router — didn't supply one); every
+        lifecycle span, in-flight table row, and flight-ring event for
+        this request carries it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = prompt.shape[0]
         if p < 1:
@@ -247,8 +311,15 @@ class ContinuousBatchingEngine:
                 f"request of {p}+{max_new_tokens} tokens needs "
                 f"{self.cache.allocator.blocks_for_tokens(p + int(max_new_tokens))} "
                 f"blocks; the pool has {self.cache.num_blocks}")
+        tel = self.telemetry
+        rid = str(request_id) if request_id is not None \
+            else mint_request_id()
+        tl = RequestTimeline(rid, time.perf_counter_ns()) \
+            if tel.enabled else None
         seq = _Seq(next(self._ids), prompt, max_new_tokens, temperature,
-                   seed)
+                   seed, rid, tl)
+        if tel.enabled:
+            tel.flight_record("serve", "submit", tag=rid)
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine closed")
@@ -316,6 +387,16 @@ class ContinuousBatchingEngine:
             admitted.append(seq)
         self._set_depth_locked()
         self._running.extend(admitted)
+        if admitted and self.telemetry.enabled:
+            # close each admitted sequence's waiting episode: queue on
+            # first admission, replay-wait after a preemption bounce
+            now = time.perf_counter_ns()
+            for s in admitted:
+                if s.tl is not None:
+                    s.tl.note("queue" if s.preempts == 0 else "replay",
+                              s.tl.t_wait_start, now)
+                    self.telemetry.flight_record("serve", "admit",
+                                                 tag=s.rid)
         return admitted
 
     # ------------------------------------------------------------------
@@ -355,6 +436,7 @@ class ContinuousBatchingEngine:
                 ids[i, :p] = s.prompt
                 ids[i, p:] = s.prompt[-1]   # edge pad stays in-vocab
                 slots[i, :p] = self.cache.slot_mapping(s.id, 0, p)
+            t0 = time.perf_counter_ns() if tel.enabled else 0
             logits, pools = self._dispatch(
                 ("prefill", bb, pb), self._prefill_fn, self.params,
                 self.cache.pools, jnp.asarray(ids), jnp.asarray(slots))
@@ -363,12 +445,20 @@ class ContinuousBatchingEngine:
                 logits[jnp.arange(len(group)),
                        jnp.asarray([s.prompt.shape[0] - 1
                                     for s in group])])
+            # episode ends AFTER the host sync above — the wall between
+            # t0 and t1 is the prefill compute each member rode
+            t1 = time.perf_counter_ns() if tel.enabled else 0
             for i, s in enumerate(group):
                 p = s.prompt.shape[0]
                 tok = _choose_token(last[i], s.temperature, s.seed, 0)
                 s.generated.append(tok)
                 s.pending = tok
                 s.n_written = p
+                if s.tl is not None:
+                    s.tl.note("replay" if s.replaying() else "prefill",
+                              t0, t1)
+                    if s.tl.t_first_token is None:
+                        s.tl.t_first_token = t1     # TTFT point
             if tel.enabled:
                 real = sum(s.prompt.shape[0] for s in group)
                 tel.inc(f"{self.name}_prefill_tokens", real)
@@ -398,6 +488,8 @@ class ContinuousBatchingEngine:
         recompute reproduces its tokens ((seed, index)-keyed
         sampling)."""
         self.cache.free_seq(victim.id)
+        lost = len(victim.generated)
+        victim.tokens_lost = lost
         victim.generated = []
         victim.pending = None
         victim.n_written = 0
@@ -408,6 +500,14 @@ class ContinuousBatchingEngine:
             self._set_depth_locked()
         if self.telemetry.enabled:
             self.telemetry.inc(f"{self.name}_preemptions")
+            self.telemetry.instant("serve_preempt",
+                                   request_id=victim.rid, tokens=lost)
+            self.telemetry.flight_record("serve", "preempt",
+                                         tag=victim.rid)
+            if victim.tl is not None:
+                # the replay-wait episode starts now and closes at
+                # re-admission (_admit_locked)
+                victim.tl.t_wait_start = time.perf_counter_ns()
 
     def _decode_once(self):
         import jax.numpy as jnp
@@ -430,6 +530,8 @@ class ContinuousBatchingEngine:
             tokens[i] = s.pending
             positions[i] = s.n_written
             write_slots[i] = self.cache.slot_of(s.id, s.n_written)
+        tel = self.telemetry
+        t0 = time.perf_counter_ns() if tel.enabled else 0
         logits, pools = self._dispatch(
             ("decode", bb, cb), self._step_fn, self.params,
             self.cache.pools, jnp.asarray(tokens),
@@ -437,14 +539,20 @@ class ContinuousBatchingEngine:
             jnp.asarray(write_slots))
         self.cache.pools = pools
         last = np.asarray(logits[:len(active)])
+        t1 = time.perf_counter_ns() if tel.enabled else 0
         for i, s in enumerate(active):
             s.n_written += 1
             tok = _choose_token(last[i], s.temperature, s.seed,
                                 len(s.generated))
             s.generated.append(tok)
             s.pending = tok
-        if self.telemetry.enabled:
-            self.telemetry.inc(f"{self.name}_tokens", len(active))
+            if s.tl is not None:
+                # a preempted sequence re-earning lost tokens is in
+                # "replay", not "decode" — the doctor's replay bucket
+                s.tl.note("replay" if s.replaying() else "decode",
+                          t0, t1)
+        if tel.enabled:
+            tel.inc(f"{self.name}_tokens", len(active))
 
     def _finish_done(self):
         tel = self.telemetry
@@ -456,7 +564,24 @@ class ContinuousBatchingEngine:
         for s in done:
             self.cache.free_seq(s.id)
             ms = (time.perf_counter() - s.t_submit) * 1e3
-            self.slo.note(True, ms)
+            ttft_ms = None
+            if s.tl is not None:
+                t_retire = time.perf_counter_ns()
+                _lifecycle.emit_request(tel, s.tl, t_retire,
+                                        len(s.generated), s.preempts)
+                tel.flight_record("serve", "retire", tag=s.rid)
+                if s.tl.t_first_token is not None:
+                    ttft_ms = (s.tl.t_first_token - s.tl.t_submit) / 1e6
+                    tel.observe("serve_ttft_ms", ttft_ms)
+                    tel.observe(
+                        "serve_tpot_ms",
+                        (t_retire - s.tl.t_first_token) / 1e6
+                        / max(1, len(s.generated) - 1))
+                tel.observe("serve_queue_wait_ms",
+                            sum(t1 - t0 for ph, t0, t1 in s.tl.episodes
+                                if ph == "queue") / 1e6)
+                tel.observe("serve_preempts", s.preempts)
+            self.slo.note(True, ms, ttft_ms=ttft_ms)
             if tel.enabled:
                 tel.observe(f"{self.name}_latency_ms", ms)
                 tel.inc(f"{self.name}_requests")

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import atexit
 import os
+import sys
 
 from .tracer import Tracer, merge_traces
 from .metrics import MetricsRegistry, uptime_gauge
@@ -171,6 +172,20 @@ class Telemetry:
             fpath = self.flight.dump(self.out_dir, reason="flush")
             if fpath:
                 self._flushed_paths.append(fpath)
+        # serving in-flight request tables ride beside the flight rings
+        # (the crash handlers call flush(), so a watchdogged engine's
+        # stuck requests land in requests_rank<r>.json without extra
+        # hooks). Looked up via sys.modules so a crash handler never
+        # IMPORTS the serving plane — if it was never loaded, there is
+        # nothing in flight to dump.
+        lifecycle = sys.modules.get("hetu_tpu.serving.lifecycle")
+        if lifecycle is not None:
+            try:
+                rpath = lifecycle.dump_inflight(self.out_dir, self.rank)
+            except Exception:   # noqa: BLE001 — never mask the crash
+                rpath = None
+            if rpath:
+                self._flushed_paths.append(rpath)
         return self._flushed_paths
 
 

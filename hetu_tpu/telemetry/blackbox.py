@@ -27,7 +27,14 @@ rerun:
   ``health_rank<r>.jsonl`` files (telemetry/health.py), the verdict
   also names the first bad step and the tripped layer/table, so a
   post-mortem on a health-tripped run reads as one story: which rank
-  died AND where the numerics first went wrong.
+  died AND where the numerics first went wrong;
+* **in-flight serving requests** — when the crash dump rode beside a
+  serving plane, ``Telemetry.flush()`` also wrote
+  ``requests_rank<r>.json`` (serving/lifecycle.py): the per-component
+  in-flight request tables at the moment of death. A crashed or
+  watchdogged engine's verdict then names the stuck requests — id,
+  phase, tokens done/budget, preempt count, age — instead of only the
+  guilty rank.
 
 Exit codes: 0 = report produced, 2 = nothing to analyze.
 """
@@ -103,14 +110,23 @@ def analyze(tdir):
         doc = _load_json(path)
         if r is not None and doc is not None:
             beats[r] = doc
+    # serving in-flight request tables dumped by Telemetry.flush()
+    # beside the flight rings (serving/lifecycle.py:dump_inflight)
+    serving = {}
+    for path in glob.glob(os.path.join(tdir, "requests_rank*.json")):
+        r = _rank_of(path, "requests")
+        doc = _load_json(path)
+        if r is not None and doc is not None:
+            serving[r] = doc
 
     expected = set(beats) | set(dumps)
     for doc in list(dumps.values()) + list(beats.values()):
         n = int(doc.get("nprocs", 0) or 0)
         if n > 1:
             expected |= set(range(n))
-    if not expected:
+    if not expected and not serving:
         return None
+    expected |= set(serving)
 
     ranks = {}
     for r in sorted(expected):
@@ -198,12 +214,32 @@ def analyze(tdir):
     if not suspects and health and health.get("bad_ranks"):
         suspects = list(health["bad_ranks"])
 
+    # -- serving in-flight requests (requests_rank<r>.json) --------------
+    serving_report = None
+    if serving:
+        serving_report = {}
+        for r, doc in sorted(serving.items()):
+            rows = []
+            for comp in doc.get("components", []) or []:
+                for req in comp.get("requests", []) or []:
+                    if isinstance(req, dict):
+                        row = dict(req)
+                        row["component"] = comp.get("name")
+                        rows.append(row)
+            serving_report[str(r)] = {
+                "stuck_requests": rows,
+                "components": [{"name": c.get("name"),
+                                "kind": c.get("kind"),
+                                "stats": c.get("stats")}
+                               for c in doc.get("components", []) or []]}
+
     return {"dir": tdir,
             "ranks": {str(r): info for r, info in ranks.items()},
             "dead_ranks": dead,
             "divergence": divergence,
             "waited_on_ranks": waited_on,
             "health": health,
+            "serving": serving_report,
             "suspect_ranks": suspects}
 
 
@@ -277,6 +313,30 @@ def format_report(rep):
                 f"on rank {health['bad_rank']} ({what}{where}) — "
                 f"`python -m hetu_tpu.telemetry.health {rep['dir']}` "
                 f"for the ranked causes")
+    serving = rep.get("serving")
+    if serving:
+        for key in sorted(serving, key=int):
+            rows = serving[key]["stuck_requests"]
+            if not rows:
+                continue
+            lines.append(f"  SERVING rank {key}: {len(rows)} request(s) "
+                         f"in flight when the dump was taken")
+            for row in rows[:5]:
+                bits = [f"phase={row.get('phase')!r}"]
+                if row.get("tokens_budget") is not None:
+                    bits.append(f"tokens {row.get('tokens_done', 0)}/"
+                                f"{row['tokens_budget']}")
+                if row.get("kv_blocks"):
+                    bits.append(f"{row['kv_blocks']} KV blocks held")
+                if row.get("preempts"):
+                    bits.append(f"{row['preempts']} preempt(s)")
+                if row.get("age_ms") is not None:
+                    bits.append(f"age {row['age_ms']:.0f}ms")
+                lines.append(f"    STUCK {row.get('request_id')!r} "
+                             f"[{row.get('component')}]: "
+                             + "; ".join(bits))
+            if len(rows) > 5:
+                lines.append(f"    ... and {len(rows) - 5} more")
     if rep["suspect_ranks"]:
         lines.append(f"  SUSPECT rank(s): {rep['suspect_ranks']}")
     else:

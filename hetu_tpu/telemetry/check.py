@@ -104,6 +104,18 @@ SPAN_SCHEMA = {
     "health_trip": {"step": _req(_INT), "kind": _req(_STR),
                     "layer": _opt(_STR), "table": _opt(_STR),
                     "value": _opt(_NUM), "limit": _opt(_NUM)},
+    # serving request lifecycle (serving/lifecycle.py + scheduler.py):
+    # one serve_request span per retired request (submit -> retire), one
+    # serve_phase span per recorded episode (queue / prefill / decode /
+    # replay), one serve_preempt instant per preemption. request_id is
+    # the end-to-end tracing id minted at ingress; the serving doctor
+    # keys its per-request conservation check on these — typed strictly,
+    # no open payload.
+    "serve_request": {"request_id": _req(_STR), "tokens": _req(_INT),
+                      "preempts": _req(_INT), "phase": _opt(_STR)},
+    "serve_phase": {"request_id": _req(_STR), "phase": _req(_STR),
+                    "tokens": _opt(_INT)},
+    "serve_preempt": {"request_id": _req(_STR), "tokens": _opt(_INT)},
     # autotuner / probe (tune/)
     "autotune_sweep": {"kernel": _req(_STR), "key": _req(_STR),
                        "chosen": _req(_STR), "picked_ms": _req(_NUM),

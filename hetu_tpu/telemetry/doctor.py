@@ -51,6 +51,19 @@ comm:compute ratio, transfer hidden fraction, cost-DB coverage gaps —
 each with a remediation pointer into the existing knobs
 (``overlap_options.lookahead`` / ``bucket_bytes``, ``pp_options`` M /
 ``fuse_ticks``, ``HETU_AUTOTUNE``).
+
+**Serving mode**::
+
+    python -m hetu_tpu.telemetry.doctor --serving TELEMETRY_DIR [--json]
+
+switches the unit of attribution from the training step to the served
+**request**: each retired request's ``serve_request``/``serve_phase``
+spans (serving/lifecycle.py) are rebuilt into a timeline and its
+end-to-end latency attributed into disjoint queue / prefill / decode /
+replay / overhead buckets (conservation checked per request), with
+TTFT/TPOT/queue-wait percentiles, preemption stats, and a top-bucket
+diagnosis citing the serving knobs (``num_blocks``,
+``max_batch_size``, ``reserve``, ``prompt_buckets``, replicas).
 """
 from __future__ import annotations
 
@@ -61,7 +74,10 @@ import os
 import sys
 
 __all__ = ["classify", "attribute_events", "attribute_trace",
-           "diagnose", "load_telemetry_dir", "main"]
+           "diagnose", "load_telemetry_dir", "main",
+           "SERVE_BUCKETS", "parse_request_events",
+           "summarize_requests", "attribute_request_events",
+           "attribute_requests_dir", "render_serving_text"]
 
 # exposed-time buckets, in claim-priority order: when two spans overlap
 # on the window's thread, the more *specific* cause wins the interval
@@ -316,6 +332,245 @@ def attribute_trace(path, tolerance=0.10):
     return out
 
 
+# -- serving mode: per-REQUEST attribution ----------------------------------
+#
+# The step attribution above answers "why is a training step slow"; the
+# serving plane's unit of latency is the request. ``--serving`` rebuilds
+# each retired request's lifecycle from its ``serve_request`` (submit ->
+# retire) and ``serve_phase`` (queue / prefill / decode / replay
+# episodes) spans and attributes the end-to-end latency into disjoint
+# buckets with the same conservation discipline: the engine records the
+# episodes sequentially on one scheduler thread, ``overhead`` is the
+# exact residual, and the check guards the arithmetic (an episode
+# leaking past retire, or overlapping episodes summing past e2e, fails
+# the request rather than silently misattributing it).
+
+SERVE_BUCKETS = ("queue", "prefill", "decode", "replay", "overhead")
+
+
+def _pctl(vals, q):
+    """Linear-interpolated percentile over a plain list (stdlib-only,
+    like the rest of this module)."""
+    if not vals:
+        return 0.0
+    vs = sorted(vals)
+    k = (len(vs) - 1) * q / 100.0
+    f = int(k)
+    c = min(f + 1, len(vs) - 1)
+    return vs[f] + (vs[c] - vs[f]) * (k - f)
+
+
+def _account_request(r, tolerance, slack_us=2.0):
+    """One parsed request -> accounted dict (all times ms). Buckets sum
+    to e2e by construction (overhead is the residual); ``conserved``
+    demands the residual is non-negative within tolerance AND every
+    episode lies inside the [submit, retire] window."""
+    t0, e2e_us = r["t0"], r["e2e"]
+    t1 = t0 + e2e_us
+    buckets = {b: 0.0 for b in SERVE_BUCKETS}
+    seen = set()
+    in_window = True
+    prefill_end = None
+    for ph, s, t in r["episodes"]:
+        buckets[ph] = buckets.get(ph, 0.0) + (t - s)
+        seen.add(ph)
+        if s < t0 - slack_us or t > t1 + slack_us:
+            in_window = False
+        if ph == "prefill" and (prefill_end is None or t < prefill_end):
+            prefill_end = t         # FIRST prefill end = TTFT point
+    claimed = sum(v for b, v in buckets.items() if b != "overhead")
+    residual = e2e_us - claimed
+    conserved = in_window and \
+        residual >= -(tolerance * max(e2e_us, 1.0) + slack_us)
+    buckets["overhead"] = max(0.0, residual)
+    # a complete timeline saw the request wait (queue) and prefill and
+    # produce at least one token — anything less means a recording site
+    # was skipped and the attribution under-claims
+    complete = "queue" in seen and prefill_end is not None \
+        and r["tokens"] >= 1
+    tokens = r["tokens"]
+    ttft_ms = (prefill_end - t0) / 1000.0 \
+        if prefill_end is not None else None
+    tpot_ms = (t1 - prefill_end) / 1000.0 / max(1, tokens - 1) \
+        if prefill_end is not None else None
+    return {
+        "request_id": r["request_id"],
+        "e2e_ms": round(e2e_us / 1000.0, 3),
+        "tokens": tokens,
+        "preempts": r["preempts"],
+        "buckets_ms": {b: round(v / 1000.0, 3)
+                       for b, v in buckets.items()},
+        "ttft_ms": None if ttft_ms is None else round(ttft_ms, 3),
+        "tpot_ms": None if tpot_ms is None else round(tpot_ms, 4),
+        "queue_ms": round(buckets["queue"] / 1000.0, 3),
+        "complete": bool(complete),
+        "conserved": bool(conserved),
+    }
+
+
+def parse_request_events(events, tolerance=0.05):
+    """One rank's trace events -> list of accounted per-request dicts
+    (retired requests only: a request with no ``serve_request`` span was
+    still in flight at export and has no e2e to attribute)."""
+    reqs = {}
+    for e in _spans(events):
+        name = e["name"]
+        if name not in ("serve_request", "serve_phase"):
+            continue
+        args = e.get("args") or {}
+        rid = args.get("request_id")
+        if not isinstance(rid, str):
+            continue
+        r = reqs.setdefault(rid, {"request_id": rid, "episodes": [],
+                                  "e2e": None, "t0": None, "tokens": 0,
+                                  "preempts": 0})
+        if name == "serve_request":
+            r["t0"] = e["ts"]
+            r["e2e"] = e["dur"]
+            try:
+                r["tokens"] = int(args.get("tokens", 0))
+                r["preempts"] = int(args.get("preempts", 0))
+            except (TypeError, ValueError):
+                pass
+        else:
+            ph = args.get("phase")
+            if isinstance(ph, str):
+                r["episodes"].append((ph, e["ts"], e["ts"] + e["dur"]))
+    return [_account_request(r, tolerance) for r in reqs.values()
+            if r["e2e"] is not None]
+
+
+# knob remediations per serving bucket — each one names a real
+# constructor argument / deployment action, mirroring _REMEDY above
+_SERVE_REMEDY = {
+    "queue": "admission-starved: raise ContinuousBatchingEngine "
+             "num_blocks (a bigger KV pool admits sooner) or "
+             "max_batch_size, or add a replica behind ReplicaRouter",
+    "prefill": "TTFT rides prompt-bucket padding: tighter "
+               "prompt_buckets (compare engine_prefill_pad_tokens vs "
+               "engine_prefill_tokens), or shorten prompts",
+    "decode": "decode-compute bound: the device is the limit — raise "
+              "max_batch_size for step occupancy, or scale replicas",
+    "replay": "preemption replay recomputes lost tokens: "
+              "reserve='full' removes mid-decode preemption, or raise "
+              "num_blocks so lazy growth stops evicting",
+    "overhead": "host scheduler overhead between dispatches: raise "
+                "max_batch_size so each step carries more sequences",
+}
+
+
+def summarize_requests(reqs, tolerance=0.05):
+    """Accounted per-request dicts -> fleet summary: bucket totals,
+    TTFT/TPOT/queue-wait percentiles, preemption stats, top bucket +
+    remedy, and the conservation verdict (every request's buckets must
+    sum to its e2e)."""
+    if not reqs:
+        return {"requests": 0, "conserved": False, "complete": False,
+                "error": "no serve_request spans found "
+                         "(was serving telemetry enabled?)"}
+    totals = {b: sum(r["buckets_ms"][b] for r in reqs)
+              for b in SERVE_BUCKETS}
+    e2e_total = sum(r["e2e_ms"] for r in reqs) or 1e-9
+    violations = [r["request_id"] for r in reqs if not r["conserved"]]
+    incomplete = [r["request_id"] for r in reqs if not r["complete"]]
+    ttfts = [r["ttft_ms"] for r in reqs if r["ttft_ms"] is not None]
+    tpots = [r["tpot_ms"] for r in reqs if r["tpot_ms"] is not None]
+    queues = [r["queue_ms"] for r in reqs]
+    e2es = [r["e2e_ms"] for r in reqs]
+    preempted = sum(1 for r in reqs if r["preempts"] > 0)
+    top = max(totals.items(), key=lambda kv: kv[1])
+    return {
+        "requests": len(reqs),
+        "conserved": not violations,
+        "complete": not incomplete,
+        "violations": violations[:20],
+        "incomplete": incomplete[:20],
+        "tolerance": tolerance,
+        "e2e_total_ms": round(e2e_total, 3),
+        "e2e_p50_ms": round(_pctl(e2es, 50), 3),
+        "e2e_p99_ms": round(_pctl(e2es, 99), 3),
+        "serve_ttft_p50_ms": round(_pctl(ttfts, 50), 3),
+        "serve_ttft_p99_ms": round(_pctl(ttfts, 99), 3),
+        "serve_tpot_p50_ms": round(_pctl(tpots, 50), 4),
+        "serve_queue_wait_p99_ms": round(_pctl(queues, 99), 3),
+        "buckets_ms": {b: round(v, 3) for b, v in totals.items()},
+        "bucket_fraction": {b: round(v / e2e_total, 4)
+                            for b, v in totals.items()},
+        "preempted_requests": preempted,
+        "preempt_rate": round(preempted / len(reqs), 4),
+        "replay_fraction": round(totals["replay"] / e2e_total, 4),
+        "top_bucket": {
+            "bucket": top[0],
+            "ms": round(top[1], 3),
+            "fraction": round(top[1] / e2e_total, 4),
+            "remedy": _SERVE_REMEDY.get(top[0], "")},
+        "slowest_requests": sorted(reqs, key=lambda r: -r["e2e_ms"])[:8],
+    }
+
+
+def attribute_request_events(events, tolerance=0.05):
+    """One event list (e.g. an in-process ``tracer.drain()``) ->
+    serving summary. ``bench.py serving_continuous`` gates on this."""
+    return summarize_requests(parse_request_events(events, tolerance),
+                              tolerance)
+
+
+def attribute_requests_dir(path, tolerance=0.05):
+    """Telemetry dir -> serving summary, requests merged across ranks
+    (requests are independent; each request's conservation is checked
+    against its own rank's clocks)."""
+    reqs = []
+    for _, events in load_telemetry_dir(path).items():
+        reqs.extend(parse_request_events(events, tolerance))
+    return summarize_requests(reqs, tolerance)
+
+
+def render_serving_text(diag):
+    if not diag.get("requests"):
+        return "serving doctor: " + diag.get("error", "no requests")
+    lines = []
+    lines.append(f"serving doctor — {diag['requests']} retired "
+                 f"request(s), e2e p50/p99 {diag['e2e_p50_ms']:.1f}/"
+                 f"{diag['e2e_p99_ms']:.1f} ms")
+    lines.append("")
+    lines.append("  bucket        total ms    fraction of e2e")
+    for b in SERVE_BUCKETS:
+        v = diag["buckets_ms"].get(b, 0.0)
+        lines.append(f"  {b:<12}{_fmt_ms(v)}    "
+                     f"{diag['bucket_fraction'].get(b, 0.0):6.1%}")
+    check = "OK" if diag["conserved"] else "FAILED"
+    lines.append(f"  conservation: buckets sum to each request's e2e "
+                 f"for {diag['requests'] - len(diag['violations'])}"
+                 f"/{diag['requests']} requests [{check}]")
+    if diag["violations"]:
+        lines.append(f"  violating: {', '.join(diag['violations'][:5])}")
+    if not diag["complete"]:
+        lines.append(f"  INCOMPLETE timelines: "
+                     f"{', '.join(diag['incomplete'][:5])}")
+    lines.append("")
+    lines.append(f"TTFT p50/p99: {diag['serve_ttft_p50_ms']:.1f}/"
+                 f"{diag['serve_ttft_p99_ms']:.1f} ms   "
+                 f"TPOT p50: {diag['serve_tpot_p50_ms']:.2f} ms   "
+                 f"queue wait p99: "
+                 f"{diag['serve_queue_wait_p99_ms']:.1f} ms")
+    lines.append(f"preempted: {diag['preempted_requests']} request(s) "
+                 f"(rate {diag['preempt_rate']:.1%}), replay fraction "
+                 f"{diag['replay_fraction']:.1%}")
+    top = diag["top_bucket"]
+    lines.append(f"top bucket: {top['bucket']} ({top['ms']:.1f} ms, "
+                 f"{top['fraction']:.1%} of total e2e)")
+    if top.get("remedy"):
+        lines.append(f"  -> {top['remedy']}")
+    lines.append("slowest requests:")
+    for r in diag["slowest_requests"][:5]:
+        bms = r["buckets_ms"]
+        dom = max(bms.items(), key=lambda kv: kv[1])
+        lines.append(f"  {r['e2e_ms']:9.1f} ms  {r['request_id']}  "
+                     f"tokens={r['tokens']} preempts={r['preempts']} "
+                     f"dominant={dom[0]} ({dom[1]:.1f} ms)")
+    return "\n".join(lines)
+
+
 # -- diagnosis --------------------------------------------------------------
 
 # the static-verifier code that lints each bucket's pattern before a
@@ -526,6 +781,10 @@ def main(argv=None):
                              "(default: the standard DB if it exists)")
     parser.add_argument("--tolerance", type=float, default=0.10,
                         help="conservation tolerance (default 0.10)")
+    parser.add_argument("--serving", action="store_true",
+                        help="request-level serving attribution "
+                             "(serve_request/serve_phase spans) instead "
+                             "of step attribution")
     parser.add_argument("--json", action="store_true")
     args = parser.parse_args(argv)
 
@@ -533,6 +792,18 @@ def main(argv=None):
         print(f"no such telemetry dir: {args.telemetry}",
               file=sys.stderr)
         return 2
+    if args.serving:
+        tol = args.tolerance if args.tolerance != 0.10 else 0.05
+        diag = attribute_requests_dir(args.telemetry, tolerance=tol)
+        if args.json:
+            print(json.dumps(diag, indent=1, sort_keys=True))
+        else:
+            print(render_serving_text(diag))
+        if not diag["requests"]:
+            print("doctor: no serve_request spans in the trace "
+                  "(was serving telemetry enabled?)", file=sys.stderr)
+            return 1
+        return 0 if diag["conserved"] and diag["complete"] else 1
     per_rank = attribute_trace(args.telemetry, tolerance=args.tolerance)
     db = None
     from .costdb import CostDB, default_db_path

@@ -42,7 +42,16 @@ _HIGHER_IS_BETTER = ("/sec", "samples", "tokens", "flops", "rate")
 # better.)
 _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
                     "bubble_fraction": True, "autoplan_vs_hand": False,
-                    "serve_p99_ms": True, "kv_hbm_utilization": False}
+                    "serve_p99_ms": True, "kv_hbm_utilization": False,
+                    # request-level serving percentiles stamped by
+                    # bench_serving_continuous from the doctor's
+                    # per-request attribution (serving/lifecycle.py):
+                    # time-to-first-token tail, median per-token decode
+                    # latency, and queue-wait tail — all latencies, all
+                    # lower-is-better
+                    "serve_ttft_p99_ms": True,
+                    "serve_tpot_p50_ms": True,
+                    "serve_queue_wait_p99_ms": True}
 
 # informational per-record fields: the health monitor's stamps
 # (telemetry/health.py — a loss_finite flip is a broken run to
