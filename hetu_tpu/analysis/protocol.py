@@ -484,11 +484,23 @@ def canonical_scenarios(spec=None, **overrides):
             [("push", 1, 0), ("push", 0, 0), ("wait",)]]),
         # HT706: drain-then-checkpoint save, then a kill of server 0 —
         # the acked pre-save pushes (both shards in flight) must
-        # survive the restart
+        # survive the restart. recovery_replays models the shipped
+        # recovery: the client replays its acked (worker, seq) window
+        # into the surviving replica on failover (ps_client.cc)
         mk("failover",
            [[("push", 0, 0), ("push", 1, 0), ("wait",), ("bar",),
              ("save",), ("kill", 0), ("pull", 0, 1), ("pull", 1, 1)],
-            [("push", 0, 0), ("push", 1, 0), ("wait",), ("bar",)]]),
+            [("push", 0, 0), ("push", 1, 0), ("wait",), ("bar",)]],
+           recovery_replays=True),
+        # HT706: kill with NO covering checkpoint — before replicated
+        # shards this scenario could only pass by checkpoint luck; now
+        # acked pushes survive an arbitrary-point kill because the
+        # replay window covers everything acked since the snapshot
+        mk("failover_nosave",
+           [[("push", 0, 0), ("push", 1, 0), ("wait",),
+             ("kill", 0), ("pull", 0, 1), ("pull", 1, 1)],
+            [("push", 0, 0), ("wait",)]],
+           recovery_replays=True),
     ]
 
 

@@ -89,8 +89,13 @@ RPC_KIND_OPS = {
     "ps_sparse_pull": ("SparsePull", True),
     "ps_sync_embedding": ("SyncEmbedding", True),
     "ps_push_embedding": ("PushEmbedding", False),
+    "ps_push_sync_embedding": ("PushSyncEmbedding", True),
     "ps_barrier": ("Barrier", True),
 }
+
+# server-originated sends (the replication forwarder stamps the wrapped
+# header itself) — such an op legitimately has no ps_client.cc encoder
+_SEND_RE = re.compile(r"h\.op\s*=\s*static_cast<uint32_t>\(Op::k(\w+)\)")
 
 
 class WireOp:
@@ -98,13 +103,15 @@ class WireOp:
 
     __slots__ = ("name", "value", "enum_line", "server_cases",
                  "server_reads", "server_writes", "mutating",
-                 "accumulating", "dedup_guarded", "client_sites")
+                 "accumulating", "dedup_guarded", "client_sites",
+                 "server_sends")
 
     def __init__(self, name, value, enum_line):
         self.name = name
         self.value = value
         self.enum_line = enum_line            # line in ps_common.h
         self.server_cases = []                # [(path, line)]
+        self.server_sends = []                # [(path, line)] server-side
         self.server_reads = []                # request field sequence
         self.server_writes = []               # response field sequence
         self.mutating = False
@@ -184,6 +191,13 @@ def _parse_enum(spec, path):
 
 def _parse_server(spec, path):
     lines = spec.sources[path]
+    # server-side senders (repl_send's forwarded-header stamp)
+    for i, line in enumerate(lines, 1):
+        m = _SEND_RE.search(line)
+        if m:
+            op = spec.ops.get(m.group(1))
+            if op is not None:
+                op.server_sends.append((path, i))
     # split the switch into case blocks; consecutive labels share one
     cases = [(i, _CASE_RE.match(line).group(1))
              for i, line in enumerate(lines, 1) if _CASE_RE.match(line)]
@@ -490,7 +504,11 @@ def wire_pass(report, native_dir=None, py_dir=None, spec=None):
                  f"the full retry budget against status -100",
                  [enum_site] + [(s["path"], s["line"])
                                 for s in op.client_sites], op=op.name)
-        elif not op.client_sites and op.server_cases:
+        elif not op.client_sites and op.server_cases \
+                and not op.server_sends:
+            # ops the SERVER originates (kReplForward: a primary stamps
+            # the wrapped header in repl_send) have their encoder in
+            # ps_server.cc by design — not a dead handler
             _add(spec, report, "HT701", "warn",
                  f"Op::k{op.name} has a server handler "
                  f"(ps_server.cc:{op.server_cases[0][1]}) but no client "

@@ -159,43 +159,72 @@ def _is_local(host):
     return host in ("localhost", "127.0.0.1")
 
 
-def _ps_env(cfg, endpoints):
+def _ps_env(cfg, endpoints, backups=None):
     env = {}
     if endpoints:
         env["HETU_PS_HOSTS"] = ",".join(h for h, _ in endpoints)
         env["HETU_PS_PORTS"] = ",".join(str(p) for _, p in endpoints)
         env["HETU_PS_NWORKERS"] = str(cfg.num_workers)
+    if backups:
+        # clients fail over to these per-shard replicas (ps_client.cc)
+        env["HETU_PS_BACKUP_HOSTS"] = ",".join(h for h, _ in backups)
+        env["HETU_PS_BACKUP_PORTS"] = ",".join(str(p)
+                                               for _, p in backups)
     return env
+
+
+def _backup_endpoints(cfg, endpoints):
+    """One backup endpoint per primary shard (HETU_PS_REPLICATE=1):
+    single-host probes fresh free ports; multi-host extends the
+    deterministic range past the primaries."""
+    if os.environ.get("HETU_PS_REPLICATE", "0") in ("0", "", "false") \
+            or not endpoints:
+        return []
+    if cfg.single_host:
+        return cfg.server_endpoints()
+    base = int(os.environ.get("HETU_PS_BASE_PORT", "18590"))
+    return cfg.server_endpoints(base_port=base + len(endpoints))
+
+
+def _spawn_one_server(cfg, host, port, senv, identify, pkg_root):
+    """Fork (or ssh) one PS server process."""
+    if _is_local(host):
+        pypath = pkg_root + os.pathsep + os.environ.get(
+            "PYTHONPATH", "")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "hetu_tpu.ps.run_server",
+             str(port), str(cfg.num_workers)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": pypath, **senv})
+    else:
+        import shlex
+        ssh = ["ssh"] + (["-i", identify] if identify else []) + [host]
+        remote = " ".join(shlex.quote(a) for a in [
+            "python3", "-m", "hetu_tpu.ps.run_server",
+            str(port), str(cfg.num_workers)])
+        exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                           for k, v in senv.items())
+        # remote spawns need the package on PYTHONPATH too
+        p = subprocess.Popen(
+            ssh + [f"env PYTHONPATH={shlex.quote(pkg_root)} "
+                   f"JAX_PLATFORMS=cpu {exports} {remote}"])
+    _procs.append(p)
+    return p
 
 
 def _spawn_servers(cfg, endpoints, identify=None, extra_env=None):
     """Start every PS server (local fork; ssh for remote hosts).
     ``extra_env`` maps endpoint index -> env dict (telemetry scrape
-    port per server)."""
+    port per server; replication target for primaries). Returns one
+    record per server — the watchdog's respawn handle."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    servers = []
     for i, (host, port) in enumerate(endpoints):
         senv = (extra_env or {}).get(i, {})
-        if _is_local(host):
-            pypath = pkg_root + os.pathsep + os.environ.get(
-                "PYTHONPATH", "")
-            p = subprocess.Popen(
-                [sys.executable, "-m", "hetu_tpu.ps.run_server",
-                 str(port), str(cfg.num_workers)],
-                env={**os.environ, "JAX_PLATFORMS": "cpu",
-                     "PYTHONPATH": pypath, **senv})
-        else:
-            import shlex
-            ssh = ["ssh"] + (["-i", identify] if identify else []) + [host]
-            remote = " ".join(shlex.quote(a) for a in [
-                "python3", "-m", "hetu_tpu.ps.run_server",
-                str(port), str(cfg.num_workers)])
-            exports = " ".join(f"{k}={shlex.quote(str(v))}"
-                               for k, v in senv.items())
-            # remote spawns need the package on PYTHONPATH too
-            p = subprocess.Popen(
-                ssh + [f"env PYTHONPATH={shlex.quote(pkg_root)} "
-                       f"JAX_PLATFORMS=cpu {exports} {remote}"])
-        _procs.append(p)
+        p = _spawn_one_server(cfg, host, port, senv, identify, pkg_root)
+        servers.append({"proc": p, "host": host, "port": port,
+                        "env": senv, "identify": identify,
+                        "pkg_root": pkg_root})
     # wait for every endpoint to accept — remote ones included (a worker
     # whose PSClient connects before its server binds raises immediately)
     from .ps.server import _port_open
@@ -207,6 +236,7 @@ def _spawn_servers(cfg, endpoints, identify=None, extra_env=None):
             assert time.time() < deadline, \
                 f"PS server {host}:{port} not up"
             time.sleep(0.05)
+    return servers
 
 
 def _worker_env(cfg, base_env, rank, coordinator=None):
@@ -360,8 +390,20 @@ def launch_command(cfg, command, identify=None, telemetry=None,
                              "HETU_TELEMETRY": tdir}
             print(f"telemetry: PS server {i} scrape at "
                   f"http://{host}:{scrape_base + i}/metrics")
-    _spawn_servers(cfg, endpoints, identify, extra_env=server_env)
-    ps_env = _ps_env(cfg, endpoints)
+    # replicated shards (HETU_PS_REPLICATE=1): backups come up first so
+    # each primary can dial its replication target at startup; workers
+    # learn both endpoint lists and fail over client-side
+    backups = _backup_endpoints(cfg, endpoints)
+    backup_recs = []
+    if backups:
+        backup_recs = _spawn_servers(cfg, backups, identify)
+        for i, (bhost, bport) in enumerate(backups):
+            server_env.setdefault(i, {}).update({
+                "HETU_PS_MY_BACKUP_HOST": bhost,
+                "HETU_PS_MY_BACKUP_PORT": str(bport)})
+    servers = _spawn_servers(cfg, endpoints, identify,
+                             extra_env=server_env)
+    ps_env = _ps_env(cfg, endpoints, backups)
     if tdir:
         ps_env["HETU_TELEMETRY"] = tdir
     if health:
@@ -426,7 +468,8 @@ def launch_command(cfg, command, identify=None, telemetry=None,
             rank += 1
 
     if hang_timeout:
-        rc = _wait_with_watchdog(workers, tdir, float(hang_timeout))
+        rc = _wait_with_watchdog(workers, tdir, float(hang_timeout),
+                                 servers=servers + backup_recs, cfg=cfg)
     else:
         rc = 0
         for p in workers:
@@ -438,15 +481,46 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     return rc
 
 
-def _wait_with_watchdog(workers, tdir, hang_timeout):
+def _respawn_dead_servers(servers, cfg):
+    """In-job PS failover, launcher side: a dead server process is NOT
+    a fleet failure — clients flip to the shard's other replica and
+    replay their acked-push window (ps_client.cc), so the launcher just
+    respawns a fresh standby on the same endpoint (it rejoins empty;
+    the one-way client flip never reads it, but a later death of the
+    surviving replica has somewhere to forward to)."""
+    for srec in servers or []:
+        p = srec["proc"]
+        if p.poll() is None:
+            continue
+        host, port = srec["host"], srec["port"]
+        if not _is_local(host):
+            print(f"watchdog: PS server {host}:{port} exited "
+                  f"rc={p.returncode}; remote respawn unsupported — "
+                  f"clients run on the surviving replica")
+            srec["proc"] = subprocess.Popen(["true"])   # stop re-firing
+            continue
+        print(f"watchdog: PS server {host}:{port} exited "
+              f"rc={p.returncode} — respawning standby (clients fail "
+              f"over to the backup replica and replay)")
+        srec["proc"] = _spawn_one_server(
+            cfg, host, port, srec["env"], srec["identify"],
+            srec["pkg_root"])
+
+
+def _wait_with_watchdog(workers, tdir, hang_timeout, servers=None,
+                        cfg=None):
     """Poll the fleet under the watchdog: normal completion returns the
     usual first-nonzero rc; a stalled rank triggers the diagnose-then-
-    kill sequence and the distinct watchdog exit code."""
+    kill sequence and the distinct watchdog exit code. A dead PS server
+    is survivable (replicated shards) — it respawns instead of failing
+    the fleet."""
     from .telemetry.watchdog import FleetWatchdog
     wd = FleetWatchdog(tdir, num_workers=len(workers),
                        timeout=hang_timeout)
     by_rank = dict(enumerate(workers))
     while any(p.poll() is None for p in workers):
+        if cfg is not None:
+            _respawn_dead_servers(servers, cfg)
         stalled = wd.check(by_rank)
         if stalled:
             for rank, age, step in stalled:

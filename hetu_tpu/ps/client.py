@@ -58,26 +58,44 @@ class PSClient:
         self.lib = get_lib()
         self.nservers = self.lib.PSInit(
             hosts.encode(), str(ports).encode(), rank, nworkers)
+        self.nreplicas = int(self.lib.PSNumReplicas())
         self.rank = rank
         self.nworkers = nworkers
+        self.servers_down = False
+        self._closed = False
         # post-mortem breadcrumb: with the fleet size on the flight
         # dump, blackbox can map a pending RPC's tensor id to the
-        # server shard it was waiting on (tid % nservers)
+        # server shard (tid % nservers) and the replica set it was
+        # waiting on
         tel = _telemetry.get_telemetry()
         if tel.enabled and tel.flight is not None:
             tel.flight.meta["ps_nservers"] = int(self.nservers)
-        # fail fast on a dead server (async paths would otherwise drop
-        # requests silently)
+            tel.flight.meta["ps_nreplicas"] = self.nreplicas
+        # fail fast on a dead fleet (async paths would otherwise drop
+        # requests silently); with replication a dead primary is
+        # survivable — any reachable replica of shard 0 counts
         import socket
-        host0 = hosts.split(",")[0]
-        port0 = int(str(ports).split(",")[0])
-        try:
-            socket.create_connection((host0, port0), timeout=2).close()
-        except OSError as e:
+        probes = [(hosts.split(",")[0], int(str(ports).split(",")[0]))]
+        bhosts = os.environ.get("HETU_PS_BACKUP_HOSTS", "")
+        bports = os.environ.get("HETU_PS_BACKUP_PORTS", "")
+        if self.nreplicas > 1 and bhosts and bports:
+            probes.append((bhosts.split(",")[0],
+                           int(bports.split(",")[0])))
+        err = None
+        for host, port in probes:
+            try:
+                socket.create_connection((host, port), timeout=2).close()
+                err = None
+                break
+            except OSError as e:
+                err = e
+        if err is not None:
+            where = ", ".join(f"{h}:{p}" for h, p in probes)
             raise RuntimeError(
-                f"no PS server reachable at {host0}:{port0}; start one "
-                f"with hetu_tpu.ps.server.ensure_server() or the heturun "
-                f"launcher") from e
+                f"no PS server reachable at any replica of shard 0 "
+                f"({where}); start one with "
+                f"hetu_tpu.ps.server.ensure_server() or the heturun "
+                f"launcher") from err
 
     # -- registration ---------------------------------------------------
     def init_tensor(self, tid, shape, kind=0, init=(0, 0.0, 0.0), seed=0,
@@ -186,6 +204,56 @@ class PSClient:
                                idx.size, width)
         _flight_done(rec)
 
+    def push_sync_embedding(self, tid, push_idx, values, updates, bound,
+                            sync_idx, versions, out_rows, width):
+        """Combined PushEmbedding + SyncEmbedding in one round trip per
+        shard (kPushSyncEmbedding): applies the dirty-row push and
+        refreshes rows of ``out_rows`` whose server version is more than
+        ``bound`` ahead of ``versions`` — halving the cache's
+        drain+refresh round trips. Updates versions in place; returns
+        refreshed-row count."""
+        pidx = as_i64(push_idx).ravel()
+        vals = as_f32(values).reshape(pidx.size, width)
+        upd = as_i64(updates).ravel()
+        sidx = as_i64(sync_idx).ravel()
+        ver = as_i64(versions).ravel()
+        rec = _flight("ps_push_sync_embedding", tid,
+                      vals.nbytes + sidx.size * 4 * width)
+        with _pull_span(sidx.size * 4 * width):
+            n = self.lib.PushSyncEmbedding(
+                tid, int(bound), lptr(pidx), fptr(vals), lptr(upd),
+                pidx.size, lptr(sidx), lptr(ver), sidx.size,
+                fptr(out_rows), width)
+        _flight_done(rec)
+        versions[...] = ver.reshape(np.shape(versions))
+        return n
+
+    # -- tiered / quantized row storage ---------------------------------
+    def store_config(self, tid, dtype="f32", dram_rows=-1,
+                     spill_dir=None, hot_ids=()):
+        """Convert table ``tid`` to tiered row storage: a bounded DRAM
+        pool (``dram_rows`` resident rows per shard, <0 = all) over an
+        mmap'd disk spill file, rows quantized as ``dtype`` ("f32" |
+        "f16" | "int8"; per-row scale, dequant-on-pull). ``hot_ids``
+        (PR 9's measured hot keys) are pre-warmed into DRAM."""
+        dt = {"f32": 0, "f16": 1, "int8": 2}[dtype]
+        spill_dir = spill_dir or os.environ.get("HETU_PS_STORE_DIR",
+                                                "/tmp")
+        hot = as_i64(np.asarray(hot_ids, dtype=np.int64).ravel())
+        rc = self.lib.StoreConfig(tid, dt, int(dram_rows),
+                                  str(spill_dir).encode(), lptr(hot),
+                                  hot.size)
+        assert rc == 0, f"StoreConfig({tid}) failed: {rc}"
+
+    def store_stats(self, tid):
+        """Tiered-store counters summed across the table's shards."""
+        out = np.zeros(5, np.int64)
+        rc = self.lib.StoreStats(tid, lptr(out), out.size)
+        assert rc == 0, f"StoreStats({tid}) failed: {rc}"
+        return {"dram_hits": int(out[0]), "spill_hits": int(out[1]),
+                "spill_writes": int(out[2]), "dram_rows": int(out[3]),
+                "row_bytes": int(out[4])}
+
     # -- control --------------------------------------------------------
     def wait(self, tid):
         rec = _flight("ps_wait", tid, 0)
@@ -227,12 +295,22 @@ class PSClient:
         return int(self.lib.GetLoads())
 
     def shutdown_servers(self):
+        # idempotent + failover-aware: repeated teardown (fixture
+        # finalizers, atexit, error paths) must be a no-op, and a dead
+        # primary must not keep the surviving replica set from being
+        # notified — the C sweep sends every replica one bounded
+        # attempt instead of burning the retry budget on a dead socket
+        if self.servers_down:
+            return
         # late drains must fail fast, not burn the reconnect/retry
         # budget against servers we just stopped (PSRuntime.drain checks)
         self.servers_down = True
         self.lib.ShutdownServers()
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self.lib.PSFinalize()
 
 

@@ -324,6 +324,35 @@ class DeviceCacheTable:
         self.pulled_rows += len(pos)
         return uniq_slots[pos], out[pos]
 
+    # -- combined drain + refresh (kPushSyncEmbedding) ---------------------
+    def push_sync(self, push_ids, push_rows, upds, uniq_ids, uniq_slots):
+        """One RPC per shard that both applies the accumulated grads
+        (PushEmbedding semantics: server optimizer runs, per-row
+        versions bump by ``upds``) and refreshes the rows whose server
+        version ran more than ``pull_bound`` ahead (SyncEmbedding
+        semantics). The caller already claimed the dirty set with
+        :meth:`take_dirty`; read bookkeeping mirrors
+        :meth:`stale_check`. Returns ``(slots_to_fill, rows)`` or
+        ``(None, None)``."""
+        if self.nworkers > 1:
+            push_rows = push_rows / self.nworkers
+        vers = self.ver[uniq_slots].copy()
+        out = np.zeros((len(uniq_ids), self.width), np.float32)
+        n_ref = self.client.push_sync_embedding(
+            self.tid, push_ids, push_rows, upds, self.pull_bound,
+            uniq_ids, vers, out, self.width)
+        if not n_ref:
+            return None, None
+        pos = np.nonzero(vers != self.ver[uniq_slots])[0]
+        if len(pos) and (self.health_monitor is not None
+                         or _health.active()):
+            _health.observe_staleness(
+                "pull", self.tid, vers[pos] - self.ver[uniq_slots][pos],
+                self.pull_bound, monitor=self.health_monitor)
+        self.ver[uniq_slots[pos]] = vers[pos]
+        self.pulled_rows += len(pos)
+        return uniq_slots[pos], out[pos]
+
     # -- drain --------------------------------------------------------------
     def take_dirty(self):
         """Claim the dirty set for a push; resets counters. Returns

@@ -158,6 +158,42 @@ class Client {
       hp = he + 1;
       pp = pe + 1;
     }
+    // optional backup replica per shard (HETU_PS_BACKUP_HOSTS/PORTS,
+    // CSV parallel to the primary lists): on a dead primary the client
+    // fails over and replays its acked-update window (ROADMAP item 2)
+    const char* bh = std::getenv("HETU_PS_BACKUP_HOSTS");
+    const char* bp = std::getenv("HETU_PS_BACKUP_PORTS");
+    if (bh && bp && *bh) {
+      std::string bhs(bh), bps(bp);
+      size_t bhp = 0, bpp = 0;
+      while (bhp < bhs.size() && backups_.size() < servers_.size()) {
+        size_t he = bhs.find(',', bhp);
+        size_t pe = bps.find(',', bpp);
+        std::string host = bhs.substr(
+            bhp, he == std::string::npos ? std::string::npos : he - bhp);
+        int port = std::atoi(
+            bps.substr(bpp, pe == std::string::npos ? std::string::npos
+                                                    : pe - bpp)
+                .c_str());
+        backups_.push_back({host, port});
+        if (he == std::string::npos) break;
+        bhp = he + 1;
+        bpp = pe + 1;
+      }
+      if (backups_.size() != servers_.size()) {
+        std::fprintf(stderr,
+                     "[hetu-ps] HETU_PS_BACKUP_HOSTS/PORTS do not match "
+                     "the primary list (%zu vs %zu) — replication off\n",
+                     backups_.size(), servers_.size());
+        backups_.clear();
+      }
+    }
+    active_.assign(servers_.size(), 0);
+    window_.assign(servers_.size(), {});
+    // must cover the server's acked-but-unforwarded window
+    // (HETU_PS_REPL_LAG, default 128) or a failover can lose updates
+    replay_cap_ = static_cast<size_t>(
+        env_ms("HETU_PS_REPLAY_WINDOW", 256));
     // worker thread pool drains the async queue; joinable so finalize()
     // and the static destructor can stop them cleanly (a detached thread
     // blocked on q_cv_ at process exit deadlocks interpreter teardown)
@@ -184,6 +220,15 @@ class Client {
         if (c.ok()) ::close(c.fd);
     pool_.clear();
     servers_.clear();
+    backups_.clear();
+    {
+      std::lock_guard<std::mutex> l(act_mu_);
+      active_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> l(win_mu_);
+      window_.clear();
+    }
     {
       std::lock_guard<std::mutex> l(parts_mu_);
       parts_.clear();
@@ -311,55 +356,164 @@ class Client {
 
   int nservers() const { return static_cast<int>(servers_.size()); }
 
+  // replicas per logical shard: 1 (unreplicated) or 2 (primary+backup)
+  int nreplicas() const { return backups_.empty() ? 1 : 2; }
+
+  int active_replica(int server) {
+    std::lock_guard<std::mutex> l(act_mu_);
+    return active_.empty() ? 0 : active_[server];
+  }
+
+  // mirror of the server's mutating_op set: the ops whose acked effect
+  // must be replayed to the surviving replica after a failover
+  static bool replicated_op(Op op) {
+    return op == Op::kInitTensor || op == Op::kDensePush ||
+           op == Op::kDDPushPull || op == Op::kSparsePush ||
+           op == Op::kSDPushPull || op == Op::kSSPushPull ||
+           op == Op::kPushEmbedding || op == Op::kPushSyncEmbedding ||
+           op == Op::kParamSet || op == Op::kParamClear ||
+           op == Op::kParamLoad || op == Op::kPushData ||
+           op == Op::kStoreConfig;
+  }
+
+  // one transport attempt against one replica; true iff a framed
+  // response arrived (*status then holds the server's verdict).
+  // *delivered reports whether the request bytes were fully written —
+  // the retry-budget re-arm point.
+  bool attempt(int server, int replica, Op op, int32_t id,
+               const std::vector<uint8_t>& payload, uint64_t seq,
+               int io_ms, std::vector<uint8_t>* resp, int32_t* status,
+               bool* delivered) {
+    Conn c = take_conn(server, replica);
+    if (!c.ok()) return false;
+    set_io_timeout(c.fd, io_ms);
+    MsgHeader h;
+    h.op = static_cast<uint32_t>(op);
+    h.tensor_id = id;
+    h.payload_len = payload.size();
+    h.worker = static_cast<uint32_t>(rank_);
+    h.seq = seq;
+    if (write_full(c.fd, &h, sizeof h) &&
+        (payload.empty() ||
+         write_full(c.fd, payload.data(), payload.size()))) {
+      if (delivered) *delivered = true;
+      MsgHeader rh;
+      if (read_full(c.fd, &rh, sizeof rh) && rh.magic == h.magic) {
+        std::vector<uint8_t> body(rh.payload_len);
+        if (!rh.payload_len ||
+            read_full(c.fd, body.data(), rh.payload_len)) {
+          if (resp) *resp = std::move(body);
+          give_conn(server, replica, c);
+          *status = rh.status;
+          return true;
+        }
+      }
+    }
+    // connection failed mid-request: never pool it
+    ::close(c.fd);
+    return false;
+  }
+
+  struct Acked {
+    uint32_t op;
+    int32_t id;
+    uint64_t seq;
+    std::vector<uint8_t> payload;
+  };
+
+  void record_acked(int server, Op op, int32_t id, uint64_t seq,
+                    const std::vector<uint8_t>& payload) {
+    std::lock_guard<std::mutex> l(win_mu_);
+    auto& w = window_[server];
+    w.push_back({static_cast<uint32_t>(op), id, seq, payload});
+    while (w.size() > replay_cap_) w.pop_front();
+  }
+
+  // flip the active replica away from failed_rep (first failer wins;
+  // latecomers see the flip already done and return) and replay the
+  // acked-update window under the ORIGINAL (worker, seq) identities:
+  // the survivor's dedup drops everything its primary already
+  // forwarded, so the replay fills exactly the acked-but-unforwarded
+  // gap (bounded by the primary's HETU_PS_REPL_LAG queue, which
+  // HETU_PS_REPLAY_WINDOW must cover).
+  void fail_over(int server, int failed_rep, int io_ms) {
+    std::lock_guard<std::mutex> l(fo_mu_);
+    int next;
+    {
+      std::lock_guard<std::mutex> a(act_mu_);
+      if (active_[server] != failed_rep) return;
+      next = (failed_rep + 1) % nreplicas();
+      active_[server] = next;
+    }
+    drop_conns(server, failed_rep);
+    std::deque<Acked> replay;
+    {
+      std::lock_guard<std::mutex> wl(win_mu_);
+      replay = window_[server];
+    }
+    std::fprintf(stderr,
+                 "[hetu-ps] server %d replica %d unreachable — failing "
+                 "over to replica %d, replaying %zu acked updates\n",
+                 server, failed_rep, next, replay.size());
+    for (const auto& e : replay) {
+      int32_t st = 0;
+      attempt(server, next, static_cast<Op>(e.op), e.id, e.payload,
+              e.seq, io_ms, nullptr, &st, nullptr);
+    }
+  }
+
   // synchronous RPC with timeout + reconnect-and-retry (reference
   // ps-lite resender.h / customer.h request tracking). Each request
   // carries a (worker, seq) identity; the server dedups mutating ops,
-  // so a retry after a lost response is at-most-once. Tunables:
+  // so a retry after a lost response is at-most-once. With a backup
+  // replica set configured, a failed attempt flips the shard's active
+  // replica and replays the acked-update window before retrying (the
+  // retry itself keeps its original seq, so nothing applies twice).
+  // Tunables:
   //   HETU_PS_TIMEOUT_MS          per-attempt I/O timeout (default 15s)
   //   HETU_PS_BARRIER_TIMEOUT_MS  barrier read timeout (default 600s —
   //                               a barrier legitimately blocks on the
   //                               slowest worker)
   //   HETU_PS_RETRY_MS            total retry budget (default 30s)
+  //   HETU_PS_REPLAY_WINDOW       acked-update replay ring (default 256)
+  // ``replica`` >= 0 pins the request to that replica with a single
+  // bounded attempt (the shutdown sweep): a dead replica must not burn
+  // the retry budget.
   int32_t call(int server, Op op, int32_t id, const Writer& req,
-               std::vector<uint8_t>* resp) {
+               std::vector<uint8_t>* resp, int replica = -1) {
     const uint64_t seq = next_seq_.fetch_add(1) + 1;
     const int io_ms = (op == Op::kBarrier)
                           ? env_ms("HETU_PS_BARRIER_TIMEOUT_MS", 600000)
                           : env_ms("HETU_PS_TIMEOUT_MS", 15000);
     const int retry_ms = env_ms("HETU_PS_RETRY_MS", 30000);
+    if (replica >= 0) {
+      int32_t st = -10;
+      attempt(server, replica, op, id, req.buf, seq, io_ms, resp, &st,
+              nullptr);
+      return st;
+    }
     int64_t deadline = now_ms() + retry_ms;
     int backoff_ms = 50;
     for (;;) {
-      Conn c = take_conn(server);
-      if (c.ok()) {
-        set_io_timeout(c.fd, io_ms);
-        MsgHeader h;
-        h.op = static_cast<uint32_t>(op);
-        h.tensor_id = id;
-        h.payload_len = req.buf.size();
-        h.worker = static_cast<uint32_t>(rank_);
-        h.seq = seq;
-        if (write_full(c.fd, &h, sizeof h) &&
-            (req.buf.empty() ||
-             write_full(c.fd, req.buf.data(), req.buf.size()))) {
-          // request delivered: the failure (if any) is fresh from here,
-          // so re-arm the retry budget — otherwise a barrier that
-          // legitimately blocked past the budget would get no retries
-          deadline = now_ms() + retry_ms;
-          MsgHeader rh;
-          if (read_full(c.fd, &rh, sizeof rh) && rh.magic == h.magic) {
-            std::vector<uint8_t> body(rh.payload_len);
-            if (!rh.payload_len ||
-                read_full(c.fd, body.data(), rh.payload_len)) {
-              if (resp) *resp = std::move(body);
-              give_conn(server, c);
-              return rh.status;
-            }
-          }
-        }
-        // connection failed mid-request: never pool it
-        ::close(c.fd);
+      int rep = active_replica(server);
+      int32_t st = 0;
+      bool delivered = false;
+      if (attempt(server, rep, op, id, req.buf, seq, io_ms, resp, &st,
+                  &delivered)) {
+        if (st == 0 && nreplicas() > 1 && replicated_op(op))
+          record_acked(server, op, id, seq, req.buf);
+        return st;
       }
+      if (delivered) {
+        // request delivered: the failure (if any) is fresh from here,
+        // so re-arm the retry budget — otherwise a barrier that
+        // legitimately blocked past the budget would get no retries
+        deadline = now_ms() + retry_ms;
+      }
+      // dead replica: flip to the survivor and replay before the retry
+      // lands there (a respawned-empty primary is never read — flips
+      // are one-way until the new active fails too)
+      if (nreplicas() > 1) fail_over(server, rep, io_ms);
       if (now_ms() + backoff_ms > deadline) {
         std::fprintf(stderr,
                      "[hetu-ps] request op=%u tensor=%d to server %d "
@@ -419,32 +573,53 @@ class Client {
     }
   }
 
-  Conn take_conn(int server) {
+  // pool key folds in the replica: a pooled connection to the old
+  // primary must never serve a request addressed to the backup
+  Conn take_conn(int server, int replica) {
+    if (servers_.empty()) return Conn{};
     {
       std::lock_guard<std::mutex> l(pool_mu_);
-      auto& v = pool_[server];
+      auto& v = pool_[server * 2 + replica];
       if (!v.empty()) {
         Conn c = v.back();
         v.pop_back();
         return c;
       }
     }
+    const auto& ep = (replica == 0 || backups_.empty())
+                         ? servers_[server]
+                         : backups_[server];
     Conn c;
-    c.fd = dial(servers_[server].first, servers_[server].second,
+    c.fd = dial(ep.first, ep.second,
                 env_ms("HETU_PS_CONNECT_TIMEOUT_MS", 2000));
     return c;
   }
 
-  void give_conn(int server, Conn c) {
+  void give_conn(int server, int replica, Conn c) {
     if (!c.ok()) return;
     std::lock_guard<std::mutex> l(pool_mu_);
-    pool_[server].push_back(c);
+    pool_[server * 2 + replica].push_back(c);
+  }
+
+  void drop_conns(int server, int replica) {
+    std::lock_guard<std::mutex> l(pool_mu_);
+    auto& v = pool_[server * 2 + replica];
+    for (auto& c : v)
+      if (c.ok()) ::close(c.fd);
+    v.clear();
   }
 
   std::mutex init_mu_;
   std::unordered_map<int32_t, Part> parts_;
   std::mutex parts_mu_;
   std::vector<std::pair<std::string, int>> servers_;
+  std::vector<std::pair<std::string, int>> backups_;
+  std::vector<int> active_;            // per-server active replica
+  std::mutex act_mu_;
+  std::mutex fo_mu_;                   // serializes flip + replay
+  std::vector<std::deque<Acked>> window_;  // per-server acked ring
+  size_t replay_cap_ = 256;
+  std::mutex win_mu_;
   std::unordered_map<int, std::vector<Conn>> pool_;
   std::mutex pool_mu_;
 
@@ -764,6 +939,61 @@ int SyncEmbedding(int id, int64_t bound, const int64_t* idx, int64_t* ver,
   return refreshed.load();
 }
 
+// combined push + bounded-staleness sync (ROADMAP item 2): one round
+// trip per shard instead of the cache's PushEmbedding + SyncEmbedding
+// pair. Pushes (push_idx, grads, updates) and, in the same request,
+// refreshes rows in sync_idx whose server version moved past
+// ver[j] + bound (out/ver updated in place, SyncEmbedding's contract).
+// Returns the number of refreshed rows, or <0 on error.
+int PushSyncEmbedding(int id, int64_t bound, const int64_t* push_idx,
+                      const float* grads, const int64_t* updates,
+                      int64_t npush, const int64_t* sync_idx,
+                      int64_t* ver, int64_t nsync, float* out,
+                      int64_t width) {
+  auto& c = Client::Get();
+  auto part = c.part(id);
+  auto proute = route_sparse(part, push_idx, npush);
+  auto sroute = route_sparse(part, sync_idx, nsync);
+  std::vector<int> rcs(part.nparts(), 0);
+  std::atomic<int> refreshed{0};
+  for_parts(part.nparts(), [&](int p) {
+    if (proute.idx[p].empty() && sroute.idx[p].empty()) return;
+    auto pv = gather_rows(proute.pos[p], grads, width);
+    std::vector<int64_t> pu(proute.pos[p].size());
+    for (size_t j = 0; j < proute.pos[p].size(); ++j)
+      pu[j] = updates[proute.pos[p][j]];
+    std::vector<int64_t> pver(sroute.pos[p].size());
+    for (size_t j = 0; j < sroute.pos[p].size(); ++j)
+      pver[j] = ver[sroute.pos[p][j]];
+    Writer w;
+    w.i64(bound);
+    w.longs(proute.idx[p].data(), proute.idx[p].size());
+    w.floats(pv.data(), pv.size());
+    w.longs(pu.data(), pu.size());
+    w.longs(sroute.idx[p].data(), sroute.idx[p].size());
+    w.longs(pver.data(), pver.size());
+    std::vector<uint8_t> resp;
+    rcs[p] = c.call(part.srv[p], Op::kPushSyncEmbedding,
+                    part.pid(id, p), w, &resp);
+    if (rcs[p] != 0) return;
+    hetups::Reader rd(resp.data(), resp.size());
+    size_t npos, nver, nrows;
+    const int64_t* pos = rd.longs(&npos);   // positions in THIS sub-request
+    const int64_t* sver = rd.longs(&nver);
+    const float* rows = rd.floats(&nrows);
+    for (size_t j = 0; j < npos; ++j) {
+      size_t orig = sroute.pos[p][pos[j]];
+      ver[orig] = sver[j];
+      std::memcpy(out + orig * width, rows + j * width,
+                  width * sizeof(float));
+    }
+    refreshed += static_cast<int>(npos);
+  });
+  for (int rc : rcs)
+    if (rc != 0) return rc < 0 ? rc : -rc;
+  return refreshed.load();
+}
+
 void PushEmbedding(int id, const int64_t* idx, const float* vals,
                    const int64_t* updates, int64_t nidx, int64_t width) {
   auto& c = Client::Get();
@@ -995,6 +1225,55 @@ int PullData(int64_t key, float* out, int64_t n) {
   return 0;
 }
 
+// convert one table to tiered (bounded DRAM pool over a disk spill
+// file) + quantized row storage. dtype: 0=f32, 1=f16, 2=int8 (per-row
+// maxabs scale, dequant-on-pull). dram_rows is the per-shard DRAM row
+// budget (<0 = everything resident); hot ids (PR 9's measured hot-key
+// skew) are pre-warmed into DRAM.
+int StoreConfig(int id, int dtype, int64_t dram_rows,
+                const char* spill_dir, const int64_t* hot,
+                int64_t nhot) {
+  auto& c = Client::Get();
+  auto part = c.part(id);
+  auto route = route_sparse(part, hot, nhot);
+  int rc_all = 0;
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    w.i32(dtype);
+    w.i64(dram_rows);
+    w.str(spill_dir);
+    w.longs(route.idx[p].data(), route.idx[p].size());
+    int rc = c.call(part.srv[p], Op::kStoreConfig, part.pid(id, p), w,
+                    nullptr);
+    if (rc != 0) rc_all = rc;
+  }
+  return rc_all;
+}
+
+// aggregate tiered-store counters across one table's shards into
+// out[5] = {dram_hits, spill_hits, spill_writes, dram_rows, row_bytes}
+int StoreStats(int id, int64_t* out, int64_t n) {
+  if (n < 5) return -1;
+  auto& c = Client::Get();
+  auto part = c.part(id);
+  int64_t acc[5] = {0, 0, 0, 0, 0};
+  for (int p = 0; p < part.nparts(); ++p) {
+    Writer w;
+    std::vector<uint8_t> resp;
+    int rc = c.call(part.srv[p], Op::kStoreStats, part.pid(id, p), w,
+                    &resp);
+    if (rc != 0) return rc;
+    hetups::Reader rd(resp.data(), resp.size());
+    acc[0] += static_cast<int64_t>(rd.u64());
+    acc[1] += static_cast<int64_t>(rd.u64());
+    acc[2] += static_cast<int64_t>(rd.u64());
+    acc[3] += rd.i64();
+    acc[4] = rd.i64();          // per-row bytes: identical on every shard
+  }
+  std::memcpy(out, acc, sizeof acc);
+  return 0;
+}
+
 uint64_t GetLoads() {
   auto& c = Client::Get();
   uint64_t total = 0;
@@ -1008,11 +1287,19 @@ uint64_t GetLoads() {
   return total;
 }
 
+// replicas per logical shard (1 = unreplicated, 2 = primary + backup)
+int PSNumReplicas() { return Client::Get().nreplicas(); }
+
 void ShutdownServers() {
   auto& c = Client::Get();
   for (int s = 0; s < std::max(1, c.nservers()); ++s) {
-    Writer w;
-    c.call(s, Op::kShutdown, 0, w, nullptr);
+    // sweep every replica with one bounded attempt each: a primary
+    // that already died must not burn the retry budget or keep the
+    // surviving replica set from being notified
+    for (int r = 0; r < c.nreplicas(); ++r) {
+      Writer w;
+      c.call(s, Op::kShutdown, 0, w, nullptr, r);
+    }
   }
 }
 

@@ -31,15 +31,21 @@ enum class Op : uint32_t {
   kBarrier = 12,
   kSyncEmbedding = 13,     // bounded-staleness cache pull
   kPushEmbedding = 14,     // cache grad push (bumps versions)
-  // combined push + stale-row pull: the cache issues PushEmbedding +
-  // SyncEmbedding as two RPCs today; ROADMAP item 2's sharded fan-out
-  // is speced to fold them into this one round trip per shard
-  kPushSyncEmbedding = 15, // ht-ok: HT701 reserved for item 2 fan-out
+  // combined push + stale-row pull: one round trip per shard instead of
+  // the cache's PushEmbedding + SyncEmbedding pair (ROADMAP item 2)
+  kPushSyncEmbedding = 15,
   kGetLoads = 16,
   kShutdown = 17,
   kPushData = 18,          // generic blob store (GNN graph shards)
   kPullData = 19,
   kParamSet = 20,          // overwrite values (initial upload; no optimizer)
+  // primary->backup replication relay: header carries the ORIGINAL
+  // (worker, seq) identity; payload = u32 original op + original
+  // payload bytes, re-dispatched through handle() on the backup so the
+  // backup's (worker, seq) dedup covers client replays after failover
+  kReplForward = 21,
+  kStoreConfig = 22,       // tiered/quantized row storage for one table
+  kStoreStats = 23,        // DRAM/spill hit counters + row bytes
 };
 
 // reference ps/server/param.h:11-21

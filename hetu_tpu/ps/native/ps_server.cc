@@ -18,6 +18,8 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdio>
+#include <cstdlib>
+#include <ctime>
 #include <deque>
 #include <map>
 #include <memory>
@@ -30,6 +32,7 @@
 #include <vector>
 
 #include "ps_common.h"
+#include "ps_store.h"
 
 namespace hetups {
 
@@ -68,10 +71,32 @@ struct Tensor {
   // optimizer slots
   std::vector<float> m, v;
   int64_t step = 0;
+  // tiered/quantized row storage (kStoreConfig): when set, ``data`` is
+  // empty and every row lives in the DRAM pool or the spill file;
+  // restricted to SGD/None optimizers (no m/v slot tiering)
+  std::unique_ptr<TieredStore> store;
   mutable std::shared_mutex mu;
 
   int64_t nelem() const { return len * width; }
   float lr() const { return lrs.empty() ? 0.1f : lrs[0]; }
+
+  // one row for the read paths: direct pointer for dense tables, a
+  // dequantized copy in ``scratch`` (caller-sized to width) for tiered
+  inline const float* row_src(int64_t row, float* scratch) const {
+    if (!store) return data.data() + row * width;
+    store->read_row(row, scratch);
+    return scratch;
+  }
+
+  // materialized full-table view for the dense pull/save paths (tiered
+  // tables pay one dequant sweep; dense tables alias ``data``)
+  const float* dense_view(std::vector<float>& snap) const {
+    if (!store) return data.data();
+    snap.resize(nelem());
+    for (int64_t r = 0; r < len; ++r)
+      store->read_row(r, snap.data() + r * width);
+    return snap.data();
+  }
 
   void init_slots() {
     switch (opt) {
@@ -93,6 +118,23 @@ struct Tensor {
   void apply_dense(const float* g) {
     const int64_t n = nelem();
     const float a = lr();
+    if (store) {
+      // tiered: read-modify-write per row (SGD/None only, enforced at
+      // kStoreConfig); the dequant/requant round trip is the quantized
+      // storage contract, not an accident
+      std::vector<float> buf(width);
+      for (int64_t r = 0; r < len; ++r) {
+        store->read_row(r, buf.data());
+        const float* src = g + r * width;
+        if (opt == OptKind::kSGD) {
+          for (int64_t k = 0; k < width; ++k) buf[k] -= a * src[k];
+        } else {
+          for (int64_t k = 0; k < width; ++k) buf[k] += src[k];
+        }
+        store->write_row(r, buf.data());
+      }
+      return;
+    }
     switch (opt) {
       case OptKind::kNone:
 #pragma omp parallel for
@@ -146,6 +188,18 @@ struct Tensor {
   // one row's optimizer update from an (already aggregated) gradient
   inline void apply_row(int64_t row, const float* src, float a) {
     const int64_t w = width;
+    if (store) {
+      thread_local std::vector<float> buf;
+      buf.resize(w);
+      store->read_row(row, buf.data());
+      if (opt == OptKind::kSGD) {
+        for (int64_t k = 0; k < w; ++k) buf[k] -= a * src[k];
+      } else {
+        for (int64_t k = 0; k < w; ++k) buf[k] += src[k];
+      }
+      store->write_row(row, buf.data());
+      return;
+    }
     float* dst = data.data() + row * w;
     switch (opt) {
       case OptKind::kNone:
@@ -241,6 +295,13 @@ struct Tensor {
 
   void gather(const int64_t* idx, size_t nidx, float* out) const {
     const int64_t w = width;
+    if (store) {
+      // serial: TieredStore serializes on its own mutex anyway, and
+      // read_row zero-fills out-of-range rows like the dense branch
+      for (size_t j = 0; j < nidx; ++j)
+        store->read_row(idx[j], out + j * w);
+      return;
+    }
 #pragma omp parallel for
     for (size_t j = 0; j < nidx; ++j) {
       int64_t row = idx[j];
@@ -285,6 +346,22 @@ class Server {
     }
     std::fprintf(stderr, "[hetu-ps] serving on :%d (%d workers)\n", port_,
                  nworkers_);
+    // primary role: asynchronously forward acked mutations to the
+    // shard's backup replica (ROADMAP item 2 failover)
+    const char* bh = std::getenv("HETU_PS_MY_BACKUP_HOST");
+    const char* bp = std::getenv("HETU_PS_MY_BACKUP_PORT");
+    if (bh && bp && *bh && *bp) {
+      backup_host_ = bh;
+      backup_port_ = std::atoi(bp);
+      const char* lag = std::getenv("HETU_PS_REPL_LAG");
+      if (lag && *lag) repl_cap_ = static_cast<size_t>(std::atoi(lag));
+      if (repl_cap_ < 1) repl_cap_ = 1;
+      has_backup_ = true;
+      repl_thread_ = std::thread(&Server::repl_loop, this);
+      std::fprintf(stderr,
+                   "[hetu-ps] replicating to backup %s:%d (lag %zu)\n",
+                   backup_host_.c_str(), backup_port_, repl_cap_);
+    }
     while (!stop_.load()) {
       int cfd = ::accept(lfd, nullptr, nullptr);
       if (cfd < 0) break;
@@ -293,6 +370,15 @@ class Server {
       std::thread(&Server::serve_conn, this, cfd).detach();
     }
     ::close(lfd);
+    if (has_backup_) {
+      {
+        std::lock_guard<std::mutex> l(repl_mu_);
+        repl_stop_.store(true);
+      }
+      repl_cv_.notify_all();
+      repl_space_cv_.notify_all();
+      repl_thread_.join();
+    }
     return 0;
   }
 
@@ -314,6 +400,15 @@ class Server {
       Writer out;
       int32_t status = handle(static_cast<Op>(h.op), h.tensor_id,
                               payload, out, h.worker, h.seq);
+      // forward acked mutations to the backup BEFORE acking the client
+      // (blocking when the bounded queue is full): every update the
+      // client saw acked is either applied on the backup already or in
+      // this queue, so a client replay window >= the queue cap covers
+      // all possible loss on primary death
+      if (has_backup_ && status == 0 &&
+          mutating_op(static_cast<Op>(h.op)))
+        repl_enqueue(static_cast<Op>(h.op), h.tensor_id, h.worker,
+                     h.seq, payload);
       MsgHeader rh;
       rh.op = h.op;
       rh.tensor_id = h.tensor_id;
@@ -337,6 +432,121 @@ class Server {
       }
     }
     ::close(fd);
+  }
+
+  // ------------------------------------------------------------------
+  // primary -> backup replication (ROADMAP item 2): the ops whose
+  // acked effect must survive a primary SIGKILL
+  // ------------------------------------------------------------------
+  // ==-chain, not a switch: analysis/wire.py treats `case Op::kX:`
+  // labels as handler cases, and this helper is not one
+  static bool mutating_op(Op op) {
+    return op == Op::kInitTensor || op == Op::kDensePush ||
+           op == Op::kDDPushPull || op == Op::kSparsePush ||
+           op == Op::kSDPushPull || op == Op::kSSPushPull ||
+           op == Op::kPushEmbedding || op == Op::kPushSyncEmbedding ||
+           op == Op::kParamSet || op == Op::kParamClear ||
+           op == Op::kParamLoad || op == Op::kPushData ||
+           op == Op::kStoreConfig;
+  }
+
+  struct ReplItem {
+    uint32_t op;
+    int32_t id;
+    uint32_t worker;
+    uint64_t seq;
+    std::vector<uint8_t> payload;
+  };
+
+  void repl_enqueue(Op op, int32_t id, uint32_t worker, uint64_t seq,
+                    const std::vector<uint8_t>& payload) {
+    std::unique_lock<std::mutex> l(repl_mu_);
+    // blocking when full IS the bounded replication-lag window
+    repl_space_cv_.wait(l, [&] {
+      return repl_q_.size() < repl_cap_ || repl_stop_.load();
+    });
+    if (repl_stop_.load()) return;
+    repl_q_.push_back(
+        {static_cast<uint32_t>(op), id, worker, seq, payload});
+    repl_cv_.notify_one();
+  }
+
+  int repl_dial() {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons(static_cast<uint16_t>(backup_port_));
+    if (::inet_pton(AF_INET, backup_host_.c_str(), &a.sin_addr) != 1)
+      a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof a) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    int nd = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof nd);
+    return fd;
+  }
+
+  // relay one acked mutation: header carries the ORIGINAL (worker,
+  // seq) identity so the backup's dedup covers client replays
+  bool repl_send(int fd, const ReplItem& it) {
+    MsgHeader h;
+    h.op = static_cast<uint32_t>(Op::kReplForward);
+    h.tensor_id = it.id;
+    h.worker = it.worker;
+    h.seq = it.seq;
+    Writer w;
+    w.u32(it.op);
+    w.raw(it.payload.data(), it.payload.size());
+    h.payload_len = w.buf.size();
+    if (!write_full(fd, &h, sizeof h)) return false;
+    if (!w.buf.empty() && !write_full(fd, w.buf.data(), w.buf.size()))
+      return false;
+    MsgHeader rh;
+    if (!read_full(fd, &rh, sizeof rh) || rh.magic != 0x48505332)
+      return false;
+    std::vector<uint8_t> resp(rh.payload_len);
+    if (rh.payload_len && !read_full(fd, resp.data(), rh.payload_len))
+      return false;
+    return true;
+  }
+
+  void repl_loop() {
+    int fd = -1;
+    for (;;) {
+      ReplItem it;
+      {
+        std::unique_lock<std::mutex> l(repl_mu_);
+        repl_cv_.wait(l, [&] {
+          return !repl_q_.empty() || repl_stop_.load();
+        });
+        if (repl_q_.empty()) break;  // stopped and drained
+        it = std::move(repl_q_.front());
+        repl_q_.pop_front();
+        repl_space_cv_.notify_one();
+      }
+      bool sent = false;
+      for (int tries = 0; tries < 50 && !sent; ++tries) {
+        if (fd < 0) fd = repl_dial();
+        if (fd >= 0 && repl_send(fd, it)) {
+          sent = true;
+        } else {
+          if (fd >= 0) ::close(fd);
+          fd = -1;
+          if (repl_stop_.load()) break;
+          struct timespec ts {0, 100 * 1000 * 1000};
+          ::nanosleep(&ts, nullptr);
+        }
+      }
+      if (!sent && !repl_warned_) {
+        repl_warned_ = true;
+        std::fprintf(stderr,
+                     "[hetu-ps] backup %s:%d unreachable; replication "
+                     "degraded (client replay still covers failover)\n",
+                     backup_host_.c_str(), backup_port_);
+      }
+    }
+    if (fd >= 0) ::close(fd);
   }
 
   // at-most-once retry protection (reference ps-lite resender.h): a
@@ -417,7 +627,8 @@ class Server {
         Tensor* t = get(id);
         if (!t) return -1;
         std::shared_lock<std::shared_mutex> l(t->mu);
-        out.floats(t->data.data(), t->data.size());
+        std::vector<float> snap;
+        out.floats(t->dense_view(snap), t->nelem());
         return 0;
       }
       case Op::kDensePush:
@@ -430,8 +641,10 @@ class Server {
         std::unique_lock<std::shared_mutex> l(t->mu);
         if (!dup && static_cast<int64_t>(n) == t->nelem())
           t->apply_dense(g);
-        if (op == Op::kDDPushPull)
-          out.floats(t->data.data(), t->data.size());
+        if (op == Op::kDDPushPull) {
+          std::vector<float> snap;
+          out.floats(t->dense_view(snap), t->nelem());
+        }
         bytes_in_ += n * 4;
         return 0;
       }
@@ -470,7 +683,8 @@ class Server {
         bool dup = check_and_record(worker, seq);
         std::unique_lock<std::shared_mutex> l(t->mu);
         if (!dup) t->apply_sparse(idx, nidx, g);
-        out.floats(t->data.data(), t->data.size());
+        std::vector<float> snap;
+        out.floats(t->dense_view(snap), t->nelem());
         return 0;
       }
       case Op::kSSPushPull: {
@@ -506,6 +720,7 @@ class Server {
         std::shared_lock<std::shared_mutex> l(t->mu);
         std::vector<int64_t> stale_pos, stale_ver;
         std::vector<float> rows;
+        std::vector<float> scratch(t->width);
         for (size_t j = 0; j < nidx; ++j) {
           int64_t row = idx[j];
           if (row < 0 || row >= t->len) continue;
@@ -514,7 +729,7 @@ class Server {
             stale_ver.push_back(t->ver[row]);
             size_t o = rows.size();
             rows.resize(o + t->width);
-            std::memcpy(rows.data() + o, t->data.data() + row * t->width,
+            std::memcpy(rows.data() + o, t->row_src(row, scratch.data()),
                         t->width * sizeof(float));
           }
         }
@@ -560,6 +775,7 @@ class Server {
         }
         std::vector<int64_t> stale_pos, stale_ver;
         std::vector<float> rows;
+        std::vector<float> scratch(t->width);
         for (size_t j = 0; j < nsidx; ++j) {
           int64_t row = sidx[j];
           if (row < 0 || row >= t->len) continue;
@@ -568,7 +784,7 @@ class Server {
             stale_ver.push_back(t->ver[row]);
             size_t o = rows.size();
             rows.resize(o + t->width);
-            std::memcpy(rows.data() + o, t->data.data() + row * t->width,
+            std::memcpy(rows.data() + o, t->row_src(row, scratch.data()),
                         t->width * sizeof(float));
           }
         }
@@ -582,16 +798,35 @@ class Server {
         if (!t) return -1;
         size_t n;
         const float* p = rd.floats(&n);
+        // overwrites need the dedup too: a post-failover REPLAY of an
+        // old overwrite arriving after forwarded accumulating updates
+        // would rewind the surviving replica (retries alone would not
+        // care — re-overwriting is idempotent)
+        bool dup = check_and_record(worker, seq);
+        if (dup) return 0;
         std::unique_lock<std::shared_mutex> l(t->mu);
         if (static_cast<int64_t>(n) != t->nelem()) return -3;
-        std::memcpy(t->data.data(), p, n * sizeof(float));
+        if (t->store) {
+          for (int64_t r = 0; r < t->len; ++r)
+            t->store->write_row(r, p + r * t->width);
+        } else {
+          std::memcpy(t->data.data(), p, n * sizeof(float));
+        }
         return 0;
       }
       case Op::kParamClear: {
         Tensor* t = get(id);
         if (!t) return -1;
+        bool dup = check_and_record(worker, seq);
+        if (dup) return 0;
         std::unique_lock<std::shared_mutex> l(t->mu);
-        std::fill(t->data.begin(), t->data.end(), 0.f);
+        if (t->store) {
+          std::vector<float> z(t->width, 0.f);
+          for (int64_t r = 0; r < t->len; ++r)
+            t->store->write_row(r, z.data());
+        } else {
+          std::fill(t->data.begin(), t->data.end(), 0.f);
+        }
         return 0;
       }
       case Op::kParamSave: {
@@ -603,7 +838,9 @@ class Server {
         if (!f) return -2;
         std::fwrite(&t->len, sizeof t->len, 1, f);
         std::fwrite(&t->width, sizeof t->width, 1, f);
-        std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+        std::vector<float> snap;
+        std::fwrite(t->dense_view(snap), sizeof(float),
+                    static_cast<size_t>(t->nelem()), f);
         std::fclose(f);
         return 0;
       }
@@ -611,6 +848,8 @@ class Server {
         Tensor* t = get(id);
         if (!t) return -1;
         std::string path = rd.str();
+        bool dup = check_and_record(worker, seq);
+        if (dup) return 0;
         std::unique_lock<std::shared_mutex> l(t->mu);
         FILE* f = std::fopen(path.c_str(), "rb");
         if (!f) return -2;
@@ -620,6 +859,16 @@ class Server {
             len != t->len || width != t->width) {
           std::fclose(f);
           return -3;
+        }
+        if (t->store) {
+          std::vector<float> tmp(t->nelem());
+          size_t got = std::fread(tmp.data(), sizeof(float), tmp.size(),
+                                  f);
+          std::fclose(f);
+          if (got != tmp.size()) return -3;
+          for (int64_t r = 0; r < t->len; ++r)
+            t->store->write_row(r, tmp.data() + r * t->width);
+          return 0;
         }
         size_t got = std::fread(t->data.data(), sizeof(float),
                                 t->data.size(), f);
@@ -670,6 +919,8 @@ class Server {
         int64_t key = rd.i64();
         size_t n;
         const float* p = rd.floats(&n);
+        bool dup = check_and_record(worker, seq);
+        if (dup) return 0;
         std::unique_lock<std::shared_mutex> l(blob_mu_);
         blobs_[key].assign(p, p + n);
         return 0;
@@ -686,6 +937,78 @@ class Server {
         out.u64(bytes_in_.load());
         return 0;
       }
+      case Op::kReplForward: {
+        // relay from a primary: re-dispatch the wrapped op under its
+        // ORIGINAL (worker, seq) identity, so this replica's dedup
+        // covers the client's post-failover replay window exactly once
+        if (payload.size() < sizeof(uint32_t)) return -3;
+        uint32_t orig = rd.u32();
+        std::vector<uint8_t> inner(payload.begin() + sizeof(uint32_t),
+                                   payload.end());
+        return handle(static_cast<Op>(orig), id, inner, out, worker,
+                      seq);
+      }
+      case Op::kStoreConfig: {
+        // convert an existing table to tiered/quantized row storage:
+        // the spill file name folds in this server's port so primary
+        // and backup replicas on one host never share a file
+        Tensor* t = get(id);
+        if (!t) return -1;
+        int32_t dt = rd.i32();
+        int64_t dram_rows = rd.i64();
+        std::string dir = rd.str();
+        size_t nhot;
+        const int64_t* hot = rd.longs(&nhot);
+        bool dup = check_and_record(worker, seq);
+        std::unique_lock<std::shared_mutex> l(t->mu);
+        if (dup) return 0;
+        if (t->store) {
+          // already tiered: re-pin only — reading promotes, so the
+          // freshest measured-hot set ends resident in DRAM
+          std::vector<float> tmp(t->width);
+          for (size_t j = 0; j < nhot; ++j)
+            if (hot[j] >= 0 && hot[j] < t->len)
+              t->store->read_row(hot[j], tmp.data());
+          return 0;
+        }
+        if (t->opt != OptKind::kSGD && t->opt != OptKind::kNone)
+          return -4;  // slot-carrying optimizers are not tiered
+        std::string path = dir + "/ps_spill_" + std::to_string(id) +
+                           "_" + std::to_string(port_) + ".bin";
+        auto st = std::make_unique<TieredStore>(
+            t->len, t->width, static_cast<StoreDtype>(dt), dram_rows,
+            path);
+        if (!st->ok()) return -2;
+        // migrate: cold rows stream through (and out of) the pool;
+        // measured-hot ids (PR 9 skew telemetry) re-read LAST so they
+        // end resident in DRAM
+        for (int64_t r = 0; r < t->len; ++r)
+          st->write_row(r, t->data.data() + r * t->width);
+        std::vector<float> tmp(t->width);
+        for (size_t j = 0; j < nhot; ++j)
+          if (hot[j] >= 0 && hot[j] < t->len)
+            st->read_row(hot[j], tmp.data());
+        t->store = std::move(st);
+        t->data.clear();
+        t->data.shrink_to_fit();
+        return 0;
+      }
+      case Op::kStoreStats: {
+        Tensor* t = get(id);
+        if (!t) return -1;
+        std::shared_lock<std::shared_mutex> l(t->mu);
+        TieredStore::Stats s;
+        if (t->store)
+          s = t->store->stats();
+        else
+          s.row_bytes = t->width * 4;
+        out.u64(s.dram_hits);
+        out.u64(s.spill_hits);
+        out.u64(s.spill_writes);
+        out.i64(s.dram_rows);
+        out.i64(s.row_bytes);
+        return 0;
+      }
       case Op::kShutdown:
         return 0;
     }
@@ -695,6 +1018,17 @@ class Server {
   int port_;
   int nworkers_;
   std::atomic<bool> stop_{false};
+  // replication state (primary role only)
+  bool has_backup_ = false;
+  bool repl_warned_ = false;
+  std::string backup_host_;
+  int backup_port_ = 0;
+  std::deque<ReplItem> repl_q_;
+  size_t repl_cap_ = 128;
+  std::mutex repl_mu_;
+  std::condition_variable repl_cv_, repl_space_cv_;
+  std::atomic<bool> repl_stop_{false};
+  std::thread repl_thread_;
   std::unordered_map<int32_t, std::unique_ptr<Tensor>> store_;
   std::shared_mutex store_mu_;
   std::unordered_map<int64_t, std::vector<float>> blobs_;

@@ -20,7 +20,7 @@ _lib = None
 
 def build_lib():
     sources = ["ps_server.cc", "ps_client.cc", "ps_cache.cc",
-               "ps_common.h", "Makefile"]
+               "ps_store.cc", "ps_common.h", "ps_store.h", "Makefile"]
     newest = max(os.path.getmtime(os.path.join(_NATIVE_DIR, s))
                  for s in sources)
     if not os.path.exists(_SO_PATH) or \
@@ -65,6 +65,16 @@ def get_lib():
     lib.SyncEmbedding.argtypes = [ctypes.c_int, i64, lp, lp, i64, fp, i64]
     lib.SyncEmbedding.restype = ctypes.c_int
     lib.PushEmbedding.argtypes = [ctypes.c_int, lp, fp, lp, i64, i64]
+    lib.PushSyncEmbedding.argtypes = [ctypes.c_int, i64, lp, fp, lp,
+                                      i64, lp, lp, i64, fp, i64]
+    lib.PushSyncEmbedding.restype = ctypes.c_int
+    lib.StoreConfig.argtypes = [ctypes.c_int, ctypes.c_int, i64,
+                                ctypes.c_char_p, lp, i64]
+    lib.StoreConfig.restype = ctypes.c_int
+    lib.StoreStats.argtypes = [ctypes.c_int, lp, i64]
+    lib.StoreStats.restype = ctypes.c_int
+    lib.PSNumReplicas.argtypes = []
+    lib.PSNumReplicas.restype = ctypes.c_int
     lib.Wait.argtypes = [ctypes.c_int]
     lib.WaitAll.argtypes = []
     lib.BarrierWorker.argtypes = []

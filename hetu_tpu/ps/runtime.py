@@ -122,6 +122,10 @@ class PSRuntime:
         self._track_push_tids = None
         self._last_pushed = {}
         self._inflight_pushed = {}
+        # embedding tables converted to tiered row storage
+        # (HETU_PS_STORE_* knobs): their measured-hot id set re-pins
+        # into the server's DRAM pool at drain cadence
+        self._store_tids = set()
         self._closed = False
         # eager registration so save()/load() work before the first step
         self._register_all()
@@ -208,6 +212,7 @@ class PSRuntime:
                                         opt=opt_name, lrs=lrs)
                 self.client.set_param(tid, param.initial_value(
                     seed=self.config.seed))
+            self._maybe_store_config(tid, opt_name)
             if self.config.cstable_policy:
                 from ..cstable import CacheSparseTable
                 bound = self.config.cache_bound
@@ -231,6 +236,44 @@ class PSRuntime:
         self.registered.add(param.id)
         return True
 
+    def _maybe_store_config(self, tid, opt_name):
+        """Apply the tiered/quantized row-store env knobs to a freshly
+        registered embedding table (``HETU_PS_STORE_DTYPE`` = f32 | f16
+        | int8, ``HETU_PS_STORE_DRAM_ROWS`` resident rows per shard,
+        ``HETU_PS_STORE_DIR`` spill directory). Slot-carrying
+        optimizers keep flat f32 storage — the tiered store tracks only
+        the row payload, not Momentum/Adam slots, so the server refuses
+        them (-4); skip with a warning instead of tripping that."""
+        import os
+        dt = os.environ.get("HETU_PS_STORE_DTYPE")
+        dram = os.environ.get("HETU_PS_STORE_DRAM_ROWS")
+        if dt is None and dram is None:
+            return
+        if opt_name not in ("SGD", "None"):
+            import sys
+            print(f"[hetu-ps] table {tid}: HETU_PS_STORE_* ignored — "
+                  f"tiered rows need a stateless server optimizer, "
+                  f"got {opt_name}", file=sys.stderr)
+            return
+        hm = self.config.health_monitor
+        hot = hm.hot_ids(tid) if hm is not None else ()
+        self.client.store_config(
+            tid, dtype=dt or "f32", dram_rows=int(dram) if dram else -1,
+            hot_ids=hot)
+        self._store_tids.add(tid)
+
+    def _refresh_hot_rows(self, tid, k=1024):
+        """Re-pin the measured-hot ids (PR 9 skew telemetry) into the
+        tiered store's DRAM pool — repeat StoreConfig on a tiered table
+        is a read-promotion pass, so placement follows the observed id
+        distribution instead of a guessed prefix."""
+        hm = self.config.health_monitor
+        if hm is None or tid not in self._store_tids:
+            return
+        hot = hm.hot_ids(tid, k)
+        if len(hot):
+            self.client.store_config(tid, hot_ids=hot)
+
     def _register_device_table(self, entry):
         """Register a device-cached table on the server (kind=2 so the
         server keeps per-row versions for bounded-staleness sync)."""
@@ -252,6 +295,7 @@ class PSRuntime:
                                     lrs=lrs)
             self.client.set_param(tbl.id, tbl.initial_value(
                 seed=self.config.seed))
+        self._maybe_store_config(tbl.id, opt_name)
         push_bound = 1 if self.config.bsp else self.config.cache_bound
         rt = DeviceCacheTable(
             tbl, entry["cache"], self.client,
@@ -399,8 +443,21 @@ class PSRuntime:
             if rt.nworkers > 1:
                 with self._phase("refresh"):
                     uniq_ids = rt.id_of[uniq_slots]
-                    fill_slots, fill_rows = rt.stale_check(uniq_ids,
-                                                           uniq_slots)
+                    fut = rt._drain_future
+                    if (rt.steps_since_drain + 1 >= rt.push_bound
+                            and rt.dirty.any()
+                            and (fut is None or fut.done())):
+                        # a drain falls due this step: fold it into the
+                        # refresh as ONE kPushSyncEmbedding round trip
+                        # per shard instead of PushEmbedding +
+                        # SyncEmbedding back-to-back (take_dirty resets
+                        # the cadence, so the post-step drain skips)
+                        fill_slots, fill_rows = \
+                            self._push_sync_device_table(rt, uniq_ids,
+                                                         uniq_slots)
+                    else:
+                        fill_slots, fill_rows = rt.stale_check(
+                            uniq_ids, uniq_slots)
                     if fill_slots is not None:
                         executor.params[sid] = pad_fill(
                             executor.params[sid], fill_slots, fill_rows,
@@ -479,6 +536,7 @@ class PSRuntime:
                 rt.note_step()
                 if rt.steps_since_drain >= rt.push_bound:
                     self._drain_device_table(rt, wait=self.config.bsp)
+                    self._refresh_hot_rows(rt.tid)
 
         # 3. push PS grads / pull updated params
         track = self._track_push_tids
@@ -977,6 +1035,31 @@ class PSRuntime:
                 rt._drain_future = self._push_pool.submit(push)
             else:
                 push()
+
+    def _push_sync_device_table(self, rt, uniq_ids, uniq_slots):
+        """Fold a due drain into the staleness refresh: claim the dirty
+        rows, gather+zero their grad sums from HBM, and issue one
+        combined kPushSyncEmbedding per shard that both applies the
+        push and returns the refreshed rows. The push rides the
+        refresh's critical path (it was about to happen post-step
+        anyway), and the sync's answer reflects it."""
+        fut = rt._drain_future
+        if fut is not None:
+            fut.result()        # done (the fold gate checked) — surface
+            rt._drain_future = None
+        slots, ids, upds = rt.take_dirty()
+        if not len(slots):
+            return rt.stale_check(uniq_ids, uniq_slots)
+        executor = self.executor
+        state = executor.state[rt.cache_sid]
+        new_acc, rows_dev, n = pad_gather_zero(
+            state["acc"], slots, rt.capacity, compress=rt.drain_compress)
+        executor.state[rt.cache_sid] = {"acc": new_acc}
+        rt.pushed_rows += n
+        rows = np.asarray(jax.device_get(rows_dev))[:n]
+        if rows.dtype != np.float32:
+            rows = rows.astype(np.float32)      # widen bf16
+        return rt.push_sync(ids, rows, upds, uniq_ids, uniq_slots)
 
     def _drain_dense_cached(self, nworkers, wait=False):
         """Drain the dense HET accumulators: claim each param's HBM grad

@@ -38,8 +38,12 @@ def pick_free_port():
     return port
 
 
-def ensure_server(port=None, nworkers=None, wait_s=10.0):
+def ensure_server(port=None, nworkers=None, wait_s=10.0, extra_env=None):
     """Start a PS server subprocess on ``port`` if none is listening.
+
+    ``extra_env`` adds to the child's environment — the replication
+    hook: a primary is armed with its backup target via
+    ``HETU_PS_MY_BACKUP_HOST``/``HETU_PS_MY_BACKUP_PORT``.
 
     Startup races are resolved by an atomic port claim (ISSUE 13
     satellite): two processes — e.g. two workers of one fleet hitting
@@ -96,7 +100,8 @@ def ensure_server(port=None, nworkers=None, wait_s=10.0):
                 env={**os.environ, "JAX_PLATFORMS": "cpu",
                      "PYTHONPATH": pypath,
                      "HETU_PS_LISTEN_FD": str(lsock.fileno()),
-                     "HETU_PS_READY_FD": str(wfd)},
+                     "HETU_PS_READY_FD": str(wfd),
+                     **(extra_env or {})},
                 pass_fds=(lsock.fileno(), wfd),
                 # a fresh fd table otherwise: the child must not hold
                 # the parent's stdio pipes open past the parent's

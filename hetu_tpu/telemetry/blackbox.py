@@ -79,6 +79,7 @@ def _wire_annotate(pending, meta, dead_ranks):
     if not contract:
         return
     nservers = int(meta.get("ps_nservers", 0) or 0)
+    nreplicas = int(meta.get("ps_nreplicas", 1) or 1)
     for ev in pending:
         if ev.get("group") != "ps":
             continue
@@ -92,6 +93,11 @@ def _wire_annotate(pending, meta, dead_ranks):
             server = int(m.group(1)) % nservers
             info["server"] = server
             info["nservers"] = nservers
+            if nreplicas > 1:
+                # replicated shards (PR 18): a pending RPC against a
+                # dead primary is survivable — the client flips to the
+                # backup replica and replays its acked window
+                info["nreplicas"] = nreplicas
             if server in dead_ranks:
                 info["server_dead"] = True
         ev["wire"] = info
@@ -274,8 +280,12 @@ def format_report(rep):
             if wire:
                 bits = [wire["op"]]
                 if wire.get("server") is not None:
-                    bits.append(f"server {wire['server']}/"
-                                f"{wire['nservers']}")
+                    shard = (f"server {wire['server']}/"
+                             f"{wire['nservers']}")
+                    if wire.get("nreplicas"):
+                        shard += (f" x{wire['nreplicas']} replicas "
+                                  f"(client fails over)")
+                    bits.append(shard)
                 bits.append("awaiting " + wire["response"] + " response"
                             if wire["blocking"]
                             else "fire-and-forget (" + wire["response"]

@@ -370,6 +370,17 @@ class HealthMonitor:
                 keep = sorted(c.items(), key=lambda kv: -kv[1])[:1 << 15]
                 self._hot[int(tid)] = dict(keep)
 
+    def hot_ids(self, tid, k=1024):
+        """Top-``k`` hottest ids observed for table ``tid`` since the
+        last drain — the tiered PS store pre-warms these into its DRAM
+        pool (measured placement, not a guessed prefix)."""
+        with self._lock:
+            c = self._hot.get(int(tid))
+            if not c:
+                return np.empty(0, np.int64)
+            top = sorted(c.items(), key=lambda kv: -kv[1])[:k]
+        return np.asarray([i for i, _ in top], dtype=np.int64)
+
     def _drain_sparse(self):
         with self._lock:
             stale, self._stale = self._stale, {}

@@ -51,7 +51,23 @@ _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
                     # lower-is-better
                     "serve_ttft_p99_ms": True,
                     "serve_tpot_p50_ms": True,
-                    "serve_queue_wait_p99_ms": True}
+                    "serve_queue_wait_p99_ms": True,
+                    # fault-tolerant PS fields (bench_wdl_ps_scale):
+                    # scale_vs_1s is the 4-server/1-server throughput
+                    # ratio — higher; spill_hit_rate is the share of
+                    # tiered-store row reads the DRAM pool absorbed
+                    # rather than the disk spill file — higher (a drop
+                    # means the measured-hot pre-warm stopped keeping
+                    # the working set resident); ps_row_bytes is the
+                    # quantized on-server row stride — lower.
+                    # ps_failover_recovery_s (kill-to-next-acked-push
+                    # on the backup) is its own metric with a
+                    # "seconds" unit (already lower-is-better); the
+                    # entry covers it if it ever rides as a field.
+                    "scale_vs_1s": False,
+                    "spill_hit_rate": False,
+                    "ps_row_bytes": True,
+                    "ps_failover_recovery_s": True}
 
 # informational per-record fields: the health monitor's stamps
 # (telemetry/health.py — a loss_finite flip is a broken run to

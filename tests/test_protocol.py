@@ -434,7 +434,11 @@ def test_rpc_contract_covers_client_rpc_kinds():
     assert set(contract) == {
         "ps_pull", "ps_push", "ps_dd_pushpull", "ps_sparse_push",
         "ps_sparse_pull", "ps_sync_embedding", "ps_push_embedding",
-        "ps_barrier"}
+        "ps_push_sync_embedding", "ps_barrier"}
     assert contract["ps_push"]["blocking"] is False
     assert contract["ps_sync_embedding"]["response"] == \
+        "longs, longs, floats"
+    # the combined fan-out RPC blocks on the refreshed rows
+    assert contract["ps_push_sync_embedding"]["blocking"] is True
+    assert contract["ps_push_sync_embedding"]["response"] == \
         "longs, longs, floats"

@@ -1,0 +1,373 @@
+"""Fault-tolerant PS: replicated shards with in-job failover, the
+tiered DRAM->disk row store, and quantized rows (the fault-tolerance
+PR's test surface).
+
+Covers: (a) SIGKILL of a primary mid-run — the client flips to the
+backup replica and replays its acked window, so the post-kill state
+matches the no-kill twin exactly (exactly-once); (b) the launcher
+watchdog respawns a dead local server instead of failing the fleet
+(no exit 117); (c) a table larger than the configured DRAM row budget
+trains through the disk spill file, and reads promote rows back up;
+(d) int8/f16 row quantization round-trips within the per-row-scale
+tolerance; (e) teardown is idempotent and leaves no Python threads.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import client as ps_client
+from hetu_tpu.ps import server as ps_server
+
+
+@pytest.fixture()
+def ps_pair():
+    """A replicated shard: backup first (the primary dials it at
+    startup), then the primary armed with HETU_PS_MY_BACKUP_*."""
+    pport = ps_server.pick_free_port()
+    bport = ps_server.pick_free_port()
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    os.environ["HETU_PS_PORTS"] = str(pport)
+    os.environ["HETU_PS_BACKUP_HOSTS"] = "127.0.0.1"
+    os.environ["HETU_PS_BACKUP_PORTS"] = str(bport)
+    os.environ["HETU_PS_TIMEOUT_MS"] = "3000"
+    os.environ["HETU_PS_RETRY_MS"] = "20000"
+    backup = ps_server.ensure_server(port=bport, nworkers=1)
+    primary = ps_server.ensure_server(
+        port=pport, nworkers=1,
+        extra_env={"HETU_PS_MY_BACKUP_HOST": "127.0.0.1",
+                   "HETU_PS_MY_BACKUP_PORT": str(bport)})
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    yield client, primary, backup
+    try:
+        client.shutdown_servers()
+    except Exception:
+        pass
+    client.close()
+    ps_server.shutdown_server()
+    for k in ("HETU_PS_BACKUP_HOSTS", "HETU_PS_BACKUP_PORTS",
+              "HETU_PS_TIMEOUT_MS", "HETU_PS_RETRY_MS"):
+        os.environ.pop(k, None)
+
+
+@pytest.fixture()
+def ps1():
+    """One unreplicated server — the tiering/quantization surface."""
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    os.environ["HETU_PS_PORTS"] = str(port)
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    yield client
+    try:
+        client.shutdown_servers()
+    except Exception:
+        pass
+    client.close()
+    ps_server.shutdown_server()
+
+
+def test_client_reports_replicas(ps_pair):
+    client, _, _ = ps_pair
+    assert client.nservers == 1
+    assert client.nreplicas == 2
+
+
+def test_sigkill_primary_matches_no_kill_twin(ps_pair):
+    """Train, SIGKILL the primary, keep training: every update lands
+    exactly once, so the final state equals the analytic no-kill twin
+    (SGD lr=1.0, unit grads: param == -total_push_count)."""
+    client, primary, _ = ps_pair
+    tid = 6100
+    client.init_tensor(tid, (8,), opt="SGD", lrs=(1.0,))
+    client.set_param(tid, np.zeros(8, np.float32))
+    for _ in range(5):
+        client.push(tid, np.ones(8, np.float32))
+        client.wait(tid)
+    np.testing.assert_allclose(client.pull(tid, (8,)), -5 * np.ones(8))
+    time.sleep(0.5)          # let replication forward the acked tail
+    primary.kill()
+    primary.wait()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        client.push(tid, np.ones(8, np.float32))
+        client.wait(tid)
+    recovery = time.perf_counter() - t0
+    np.testing.assert_allclose(client.pull(tid, (8,)), -8 * np.ones(8))
+    assert recovery < 30, f"failover took {recovery:.1f}s"
+
+
+def test_sigkill_without_settle_replays_acked_window(ps_pair):
+    """Kill IMMEDIATELY after the acks — forwards may still be in
+    flight, so recovery leans on the client's acked-window replay; the
+    dedup must keep replayed-then-forwarded updates exactly-once."""
+    client, primary, _ = ps_pair
+    tid = 6101
+    client.init_tensor(tid, (4,), opt="SGD", lrs=(1.0,))
+    client.set_param(tid, np.zeros(4, np.float32))
+    for _ in range(7):
+        client.push(tid, np.ones(4, np.float32))
+        client.wait(tid)
+    primary.kill()           # no settle sleep on purpose
+    primary.wait()
+    client.push(tid, np.ones(4, np.float32))
+    client.wait(tid)
+    np.testing.assert_allclose(client.pull(tid, (4,)), -8 * np.ones(4))
+
+
+def test_sparse_state_survives_failover(ps_pair):
+    """Embedding-table state (the PR's real payload) crosses the flip:
+    sparse pushes before the kill are visible from the backup."""
+    client, primary, _ = ps_pair
+    tid = 6102
+    client.init_tensor(tid, (32, 4), kind=1, opt="SGD", lrs=(1.0,))
+    client.set_param(tid, np.zeros((32, 4), np.float32))
+    ids = np.array([1, 5, 9], np.int64)
+    client.sparse_push(tid, ids, np.ones((3, 4), np.float32), 4)
+    client.wait(tid)
+    time.sleep(0.5)
+    primary.kill()
+    primary.wait()
+    got = client.sparse_pull(tid, np.arange(32), 4)
+    want = np.zeros((32, 4), np.float32)
+    want[ids] = -1.0
+    np.testing.assert_allclose(got, want)
+
+
+def test_training_loss_matches_no_kill_twin():
+    """The acceptance property end-to-end: PS-mode training whose
+    primary is SIGKILLed mid-run produces the SAME loss stream as the
+    unreplicated no-kill twin — failover + acked-window replay is
+    exactly-once, so the kill is invisible to the optimizer."""
+    import hetu_tpu as ht
+    from hetu_tpu.executor import Executor
+
+    def graph():
+        rng = np.random.RandomState(0)
+        emb_val = rng.randn(50, 8).astype("f") * 0.1
+        w_val = rng.randn(8 * 4 + 5, 1).astype("f") * 0.1
+        dense = ht.Variable("dense", trainable=False)
+        sparse = ht.Variable("sparse", trainable=False)
+        y_ = ht.Variable("y_", trainable=False)
+        emb = ht.Variable("ctr_embedding", value=emb_val)
+        w = ht.Variable("ctr_w", value=w_val)
+        look = ht.embedding_lookup_op(emb, sparse)
+        flat = ht.array_reshape_op(look, (-1, 8 * 4))
+        feats = ht.concat_op(flat, dense, axis=1)
+        y = ht.sigmoid_op(ht.matmul_op(feats, w))
+        loss = ht.reduce_mean_op(ht.binarycrossentropy_op(y, y_), [0])
+        train_op = ht.optim.SGDOptimizer(learning_rate=0.5).minimize(
+            loss)
+        return dense, sparse, y_, loss, train_op
+
+    frng = np.random.RandomState(1)
+    feeds = [(frng.randn(16, 5).astype("f"),
+              frng.randint(0, 50, (16, 4)),
+              frng.randint(0, 2, (16, 1)).astype("f"))
+             for _ in range(14)]
+
+    def run(replicated, kill_at=None):
+        port = ps_server.pick_free_port()
+        os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+        os.environ["HETU_PS_PORTS"] = str(port)
+        os.environ["HETU_PS_TIMEOUT_MS"] = "3000"
+        primary = None
+        if replicated:
+            bport = ps_server.pick_free_port()
+            os.environ["HETU_PS_BACKUP_HOSTS"] = "127.0.0.1"
+            os.environ["HETU_PS_BACKUP_PORTS"] = str(bport)
+            ps_server.ensure_server(port=bport, nworkers=1)
+            primary = ps_server.ensure_server(
+                port=port, nworkers=1,
+                extra_env={"HETU_PS_MY_BACKUP_HOST": "127.0.0.1",
+                           "HETU_PS_MY_BACKUP_PORT": str(bport)})
+        else:
+            ps_server.ensure_server(port=port, nworkers=1)
+        client = ps_client.PSClient(rank=0, nworkers=1)
+        ps_client.set_default_client(client)
+        try:
+            dense, sparse, y_, loss, train_op = graph()
+            # prefetch=False: synchronous pushes, loss-for-loss
+            # comparable (ASP is one push stale by design)
+            exe = Executor([loss, train_op], ctx=ht.tpu(0),
+                           comm_mode="PS", prefetch=False)
+            losses = []
+            for i, (d, s, yv) in enumerate(feeds):
+                if i == kill_at:
+                    time.sleep(0.3)      # some forwards land, some not
+                    primary.kill()
+                    primary.wait()
+                losses.append(exe.run(
+                    feed_dict={dense: d, sparse: s, y_: yv}
+                )[0].asnumpy().item())
+            exe.close()
+            return losses
+        finally:
+            try:
+                client.shutdown_servers()
+            except Exception:
+                pass
+            ps_client.close_default_client()
+            ps_server.shutdown_server()
+            for k in ("HETU_PS_BACKUP_HOSTS", "HETU_PS_BACKUP_PORTS",
+                      "HETU_PS_TIMEOUT_MS"):
+                os.environ.pop(k, None)
+
+    base = run(replicated=False)
+    got = run(replicated=True, kill_at=7)
+    assert all(np.isfinite(base)) and all(np.isfinite(got))
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+def test_launcher_respawns_dead_server_in_place(tmp_path):
+    """The watchdog path: a dead local PS server record is respawned on
+    the same endpoint (fleet survives — no exit 117), an alive record
+    is left alone, and a remote record is tombstoned instead of
+    ssh-respawned."""
+    import subprocess
+    import types
+
+    from hetu_tpu.launcher import _respawn_dead_servers
+    from hetu_tpu.ps.server import _port_open, pick_free_port
+
+    cfg = types.SimpleNamespace(num_workers=1)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ps_server.__file__)))
+    port = pick_free_port()
+    dead = subprocess.Popen(["true"])
+    dead.wait()
+    alive = subprocess.Popen(["sleep", "30"])
+    remote_dead = subprocess.Popen(["false"])
+    remote_dead.wait()
+    servers = [
+        {"proc": dead, "host": "127.0.0.1", "port": port, "env": {},
+         "identify": None, "pkg_root": pkg_root},
+        {"proc": alive, "host": "127.0.0.1", "port": 1, "env": {},
+         "identify": None, "pkg_root": pkg_root},
+        {"proc": remote_dead, "host": "10.0.0.99", "port": 2,
+         "env": {}, "identify": None, "pkg_root": pkg_root},
+    ]
+    try:
+        _respawn_dead_servers(servers, cfg)
+        assert servers[0]["proc"] is not dead          # respawned
+        assert servers[1]["proc"] is alive             # untouched
+        assert servers[2]["proc"] is not remote_dead   # tombstoned
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if _port_open("127.0.0.1", port):
+                break
+            time.sleep(0.1)
+        assert _port_open("127.0.0.1", port), \
+            "respawned standby never came up"
+        # tombstone is a finished no-op proc: the watchdog loop must
+        # not re-fire the remote warning every poll
+        assert servers[2]["proc"].poll() is not None or \
+            servers[2]["proc"].wait(5) is not None
+    finally:
+        for rec in servers:
+            if rec["proc"].poll() is None:
+                rec["proc"].kill()
+        alive.kill()
+
+
+def test_spill_trains_table_beyond_dram_budget(ps1, tmp_path):
+    """512-row table, 16-row DRAM budget: every row still trains
+    (updates land via the spill file) and the store reports both a
+    bounded pool and real spill traffic."""
+    client = ps1
+    tid = 6200
+    n, w = 512, 8
+    client.init_tensor(tid, (n, w), kind=1, opt="SGD", lrs=(1.0,))
+    base = np.arange(n * w, dtype=np.float32).reshape(n, w) / 64.0
+    client.set_param(tid, base)
+    client.store_config(tid, dtype="f32", dram_rows=16,
+                        spill_dir=str(tmp_path))
+    ids = np.arange(n, dtype=np.int64)
+    client.sparse_push(tid, ids, np.ones((n, w), np.float32), w)
+    client.wait(tid)
+    got = client.sparse_pull(tid, ids, w)
+    np.testing.assert_allclose(got, base - 1.0, rtol=1e-6, atol=1e-6)
+    st = client.store_stats(tid)
+    assert st["dram_rows"] <= 16
+    assert st["spill_hits"] > 0, st
+    assert st["row_bytes"] == 4 + w * 4      # f32 rows + per-row scale
+
+
+def test_reads_promote_and_repin_refreshes_hot_set(ps1, tmp_path):
+    """A cold row's first read spills, its second is a DRAM hit
+    (read-promotion); a repeat StoreConfig with a new hot set is the
+    re-pin pass — afterwards those rows read without spill traffic."""
+    client = ps1
+    tid = 6201
+    n, w = 256, 4
+    client.init_tensor(tid, (n, w), kind=1, opt="SGD", lrs=(1.0,))
+    client.set_param(tid, np.zeros((n, w), np.float32))
+    client.store_config(tid, dtype="f32", dram_rows=32,
+                        spill_dir=str(tmp_path))
+    cold = np.array([200], np.int64)
+    s0 = client.store_stats(tid)
+    client.sparse_pull(tid, cold, w)
+    s1 = client.store_stats(tid)
+    assert s1["spill_hits"] > s0["spill_hits"]
+    client.sparse_pull(tid, cold, w)
+    s2 = client.store_stats(tid)
+    assert s2["dram_hits"] > s1["dram_hits"]
+    assert s2["spill_hits"] == s1["spill_hits"]
+    # re-pin: repeat StoreConfig pre-warms the new measured-hot set
+    hot = np.arange(100, 116, dtype=np.int64)
+    client.store_config(tid, dtype="f32", dram_rows=32,
+                        spill_dir=str(tmp_path), hot_ids=hot)
+    s3 = client.store_stats(tid)
+    client.sparse_pull(tid, hot, w)
+    s4 = client.store_stats(tid)
+    assert s4["spill_hits"] == s3["spill_hits"], \
+        "re-pinned hot rows still read from spill"
+
+
+@pytest.mark.parametrize("dtype,tol_kind", [("int8", "scale"),
+                                            ("f16", "f16")])
+def test_quantized_rows_roundtrip(ps1, tmp_path, dtype, tol_kind):
+    """Quantized rows dequantize within the per-row-scale bound (int8:
+    one scale step; f16: half-precision epsilon on the row max)."""
+    client = ps1
+    tid = 6300 if dtype == "int8" else 6301
+    n, w = 64, 8
+    client.init_tensor(tid, (n, w), kind=1, opt="SGD", lrs=(1.0,))
+    rng = np.random.RandomState(3)
+    vals = (rng.randn(n, w) * 5).astype(np.float32)
+    client.set_param(tid, vals)
+    client.store_config(tid, dtype=dtype, dram_rows=8,
+                        spill_dir=str(tmp_path))
+    got = client.sparse_pull(tid, np.arange(n), w)
+    row_max = np.abs(vals).max(axis=1, keepdims=True)
+    if tol_kind == "scale":
+        tol = row_max / 127.0 + 1e-6        # one quant step per row
+    else:
+        tol = row_max * 2 ** -10 + 1e-6     # f16 mantissa on row scale
+    assert np.all(np.abs(got - vals) <= tol), \
+        np.abs(got - vals).max()
+    st = client.store_stats(tid)
+    assert st["row_bytes"] == 4 + w * (1 if dtype == "int8" else 2)
+
+
+def test_teardown_idempotent_and_thread_clean():
+    """shutdown_servers()/close() twice is a no-op, and no Python-side
+    threads outlive the client."""
+    port = ps_server.pick_free_port()
+    os.environ["HETU_PS_HOSTS"] = "127.0.0.1"
+    os.environ["HETU_PS_PORTS"] = str(port)
+    before = set(threading.enumerate())
+    ps_server.ensure_server(port=port, nworkers=1)
+    client = ps_client.PSClient(rank=0, nworkers=1)
+    client.init_tensor(6400, (4,), opt="None")
+    client.set_param(6400, np.ones(4, np.float32))
+    client.shutdown_servers()
+    client.shutdown_servers()        # second call must be a no-op
+    client.close()
+    client.close()
+    ps_server.shutdown_server()
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive()]
+    assert not leaked, leaked
