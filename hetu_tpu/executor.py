@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from . import ingest as _ingest_engine
 from . import ndarray
 from . import telemetry as _telemetry
+from .telemetry import fleet as _fleet
 from .telemetry import memory as _memory
 from .telemetry import watchdog as _watchdog
 from .context import (DeviceGroup, get_current_context,
@@ -1334,6 +1335,26 @@ class Executor:
         # `is None` check
         self._heartbeat = _watchdog.heartbeat_from_env()
 
+        # -- fleet step timeline (telemetry/fleet.py) ------------------
+        # armed by `heturun --watch` (HETU_FLEET); None otherwise, so
+        # the disabled path stays one `is None` check per step. The
+        # injected straggler fault (HETU_FAULT_SLOW_RANK, tests/CI)
+        # rides the same plane.
+        self._fleet_timeline = _fleet.timeline_from_env(config.telemetry)
+        self._fault_slow_s = _fleet.fault_slow_from_env()
+        self._metrics_server = False
+        _mport = os.environ.get("HETU_METRICS_PORT")
+        if _mport and config.telemetry.enabled:
+            reg = config.telemetry.metrics
+            if self._fleet_timeline is not None:
+                reg.fleet_source = self._fleet_timeline.fleet_json
+            if not reg.serving:
+                try:
+                    config.telemetry.serve_metrics(int(_mport))
+                    self._metrics_server = True
+                except OSError:
+                    pass    # port taken: scrape degrades to disk
+
         # -- async-ingest accounting (hetu_tpu/ingest.py) --------------
         # every engine this session runs folds its wait/busy numbers in
         # here, so bench/metric code can report ingest_wait_ms and
@@ -1384,27 +1405,41 @@ class Executor:
         if self._run_loop_advisor is not None:
             self._run_loop_advisor.on_run_step()
         tel = self.config.telemetry
+        tl = self._fleet_timeline
         try:
             if tel.enabled:
                 t0 = time.perf_counter()
+                t0_ns = tel.clock() if tl is not None else 0
                 with tel.span("step", subgraph=name):
+                    if self._fault_slow_s:
+                        time.sleep(self._fault_slow_s)
                     out = sub.run(self, feed_dict,
                                   convert_to_numpy_ret_vals)
-                tel.observe("step_wall_ms",
-                            (time.perf_counter() - t0) * 1000.0)
+                wall_ms = (time.perf_counter() - t0) * 1000.0
+                tel.observe("step_wall_ms", wall_ms)
+                if tl is not None:
+                    tl.on_step(sub.step_count, t0_ns, tel.clock(),
+                               wall_ms)
                 # black box: step boundary into the flight ring +
                 # live/peak device bytes (no-op on backends that don't
                 # report — memory.py caches the probe)
                 tel.flight_step(sub.step_count)
                 _memory.observe_device_memory(tel)
             else:
+                if self._fault_slow_s:
+                    time.sleep(self._fault_slow_s)
                 out = sub.run(self, feed_dict, convert_to_numpy_ret_vals)
         except Exception as e:
             if _memory.is_oom(e):
                 self._report_oom(e)
             raise
         if self._heartbeat is not None:
-            self._heartbeat.beat(sub.step_count)
+            if tl is not None:
+                ms, top = tl.summary()
+                self._heartbeat.beat(sub.step_count, step_ms=ms,
+                                     top_bucket=top)
+            else:
+                self._heartbeat.beat(sub.step_count)
         if self.step_logger is not None:
             self.step_logger.end(self, subgraph=name)
         return out
@@ -1450,8 +1485,13 @@ class Executor:
         span = tel.span("step_block", steps=len(feed_dicts),
                         subgraph=name) if tel.enabled else \
             _telemetry.NULL.span("")
+        tl = self._fleet_timeline if tel.enabled else None
+        t0 = time.perf_counter()
+        t0_ns = tel.clock() if tl is not None else 0
         try:
             with span:
+                if self._fault_slow_s:
+                    time.sleep(self._fault_slow_s * len(feed_dicts))
                 if needs_ps:
                     out = self.ps_runtime.run_block(
                         sub, feed_dicts, convert_to_numpy_ret_vals)
@@ -1462,10 +1502,19 @@ class Executor:
             if _memory.is_oom(e):
                 self._report_oom(e)
             raise
+        if tl is not None:
+            tl.on_step(sub.step_count, t0_ns, tel.clock(),
+                       (time.perf_counter() - t0) * 1000.0,
+                       steps=len(feed_dicts))
         if tel.enabled:
             tel.flight_step(sub.step_count)
         if self._heartbeat is not None:
-            self._heartbeat.beat(sub.step_count)
+            if tl is not None:
+                ms, top = tl.summary()
+                self._heartbeat.beat(sub.step_count, step_ms=ms,
+                                     top_bucket=top)
+            else:
+                self._heartbeat.beat(sub.step_count)
         return out
 
     def run_batches_stream(self, blocks, name="default",
@@ -1692,6 +1741,11 @@ class Executor:
             self._heartbeat.done()
         if self.config.health_monitor is not None:
             self.config.health_monitor.close()
+        if self._fleet_timeline is not None:
+            self._fleet_timeline.dump()
+        if self._metrics_server:
+            self.config.telemetry.metrics.shutdown()
+            self._metrics_server = False
         self.config.telemetry.flush()
 
     def __del__(self):

@@ -239,7 +239,8 @@ def _spawn_servers(cfg, endpoints, identify=None, extra_env=None):
     return servers
 
 
-def _worker_env(cfg, base_env, rank, coordinator=None):
+def _worker_env(cfg, base_env, rank, coordinator=None,
+                metrics_port=None):
     env = dict(base_env)
     env["HETU_PS_RANK"] = str(rank)
     if coordinator:
@@ -247,6 +248,9 @@ def _worker_env(cfg, base_env, rank, coordinator=None):
         env["HETU_COORDINATOR"] = coordinator
         env["HETU_NUM_PROCS"] = str(cfg.num_workers)
         env["HETU_PROC_ID"] = str(rank)
+    if metrics_port:
+        # per-rank /metrics + /fleet scrape (heturun --watch)
+        env["HETU_METRICS_PORT"] = str(metrics_port)
     return env
 
 
@@ -334,7 +338,7 @@ def run_autoplan(cfg, command):
 
 
 def launch_command(cfg, command, identify=None, telemetry=None,
-                   hang_timeout=None, health=None):
+                   hang_timeout=None, health=None, watch=False):
     """Run ``command`` once per worker with the cluster env wired
     (the ``heturun -c conf.yml python train.py`` path).
 
@@ -363,10 +367,22 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     watchdog code (telemetry/watchdog.py) — a hung pipeline becomes a
     diagnosed failure instead of an eternal CI timeout. The watchdog
     implies telemetry (a temp dir is created when ``--telemetry`` was
-    not given)."""
+    not given).
+
+    ``watch`` (from ``--watch``) arms the live fleet plane
+    (telemetry/fleet.py): workers record per-step timelines
+    (HETU_FLEET) and serve ``/fleet`` on a per-rank metrics port; the
+    launcher runs a FleetMonitor that polls heartbeats + scrapes, and
+    prints a refreshing straggler/drift dashboard while the fleet
+    runs, persisting ``fleet_report.json``. Implies telemetry."""
     endpoints = cfg.server_endpoints()
     server_env = {}
     tdir = None
+    if watch and not telemetry:
+        import tempfile
+        telemetry = tempfile.mkdtemp(prefix="hetu-fleet-")
+        print(f"fleet: --watch without --telemetry; timelines and the "
+              f"fleet report go to {telemetry}")
     if hang_timeout and not telemetry:
         import tempfile
         telemetry = tempfile.mkdtemp(prefix="hetu-watchdog-")
@@ -409,6 +425,27 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     if health:
         # every worker's Executor resolves health_options from the env
         ps_env["HETU_HEALTH"] = str(health)
+    metrics_ports = None
+    if watch:
+        ps_env["HETU_FLEET"] = "1"
+        # live skew signal needs heartbeats even without --hang-timeout:
+        # arm the heartbeat writer (the watchdog itself only fires when
+        # hang_timeout is set)
+        ps_env.setdefault("HETU_WATCHDOG_DIR", tdir)
+        metrics_ports = {}
+        if cfg.single_host:
+            from .ps.server import pick_free_port
+            for r in range(cfg.num_workers):
+                metrics_ports[r] = pick_free_port()
+        else:
+            mbase = int(os.environ.get("HETU_METRICS_BASE_PORT",
+                                       "18890"))
+            for r in range(cfg.num_workers):
+                metrics_ports[r] = mbase + r
+            print("fleet: WARNING multi-host fleet — /fleet scrapes "
+                  "and flushed timelines cover launcher-local ranks "
+                  "only; remote ranks contribute heartbeat signal "
+                  "written on their own filesystem")
     if hang_timeout:
         ps_env["HETU_WATCHDOG_DIR"] = tdir
         ps_env["HETU_HANG_TIMEOUT"] = str(float(hang_timeout))
@@ -449,7 +486,9 @@ def launch_command(cfg, command, identify=None, telemetry=None,
     rank = 0
     for host, n in cfg.worker_hosts():   # chief first: rank 0 on chief
         for _ in range(n):
-            wenv = _worker_env(cfg, ps_env, rank, coordinator)
+            wenv = _worker_env(
+                cfg, ps_env, rank, coordinator,
+                metrics_port=(metrics_ports or {}).get(rank))
             wenv["PYTHONPATH"] = pypath
             if _is_local(host):
                 p = subprocess.Popen(command,
@@ -467,9 +506,12 @@ def launch_command(cfg, command, identify=None, telemetry=None,
             _procs.append(p)
             rank += 1
 
-    if hang_timeout:
-        rc = _wait_with_watchdog(workers, tdir, float(hang_timeout),
-                                 servers=servers + backup_recs, cfg=cfg)
+    if hang_timeout or watch:
+        rc = _wait_with_watchdog(workers, tdir,
+                                 float(hang_timeout or 0.0),
+                                 servers=servers + backup_recs, cfg=cfg,
+                                 watch=watch,
+                                 metrics_ports=metrics_ports)
     else:
         rc = 0
         for p in workers:
@@ -507,33 +549,81 @@ def _respawn_dead_servers(servers, cfg):
             srec["pkg_root"])
 
 
+def _make_fleet_monitor(workers, tdir, metrics_ports):
+    """Launcher-side FleetMonitor (heturun --watch): its Telemetry has
+    NO out_dir on purpose — the monitor must not install crash handlers
+    or atexit flushes in the launcher process; its fleet_watch/drift
+    trace is exported explicitly to ``trace_fleet.json``."""
+    from .telemetry import Telemetry
+    from .telemetry.fleet import FleetMonitor
+    mtel = Telemetry(enabled=True, rank=len(workers) + 900,
+                     service="fleet-monitor")
+    return FleetMonitor(
+        tdir, num_workers=len(workers), metrics_ports=metrics_ports,
+        telemetry=mtel,
+        out_path=os.path.join(tdir, "fleet_report.json"))
+
+
+def _finish_fleet_monitor(monitor, tdir, show=True):
+    """Final forced poll + report + trace export (normal exit AND the
+    watchdog-fire path — the last window is the interesting one)."""
+    from .telemetry.fleet import render_report
+    try:
+        rep = monitor.poll(force=True)
+        if rep is not None and show:
+            print(render_report(rep), flush=True)
+        monitor.tel.tracer.export(os.path.join(tdir, "trace_fleet.json"))
+        print(f"fleet: report -> "
+              f"{os.path.join(tdir, 'fleet_report.json')}")
+    except Exception as e:     # noqa: BLE001 — monitoring must not
+        print(f"fleet: WARNING final report failed: {e}")   # kill rc
+
+
 def _wait_with_watchdog(workers, tdir, hang_timeout, servers=None,
-                        cfg=None):
-    """Poll the fleet under the watchdog: normal completion returns the
-    usual first-nonzero rc; a stalled rank triggers the diagnose-then-
-    kill sequence and the distinct watchdog exit code. A dead PS server
-    is survivable (replicated shards) — it respawns instead of failing
-    the fleet."""
+                        cfg=None, watch=False, metrics_ports=None):
+    """Poll the fleet under the watchdog and/or the live fleet monitor:
+    normal completion returns the usual first-nonzero rc; a stalled
+    rank triggers the diagnose-then-kill sequence and the distinct
+    watchdog exit code. A dead PS server is survivable (replicated
+    shards) — it respawns instead of failing the fleet. With ``watch``
+    the FleetMonitor refreshes the straggler/drift dashboard between
+    checks (throttled internally to its polling interval)."""
+    from .telemetry.fleet import render_report
     from .telemetry.watchdog import FleetWatchdog
-    wd = FleetWatchdog(tdir, num_workers=len(workers),
-                       timeout=hang_timeout)
+    wd = None
+    if hang_timeout:
+        wd = FleetWatchdog(tdir, num_workers=len(workers),
+                           timeout=hang_timeout)
+    monitor = _make_fleet_monitor(workers, tdir, metrics_ports) \
+        if watch else None
     by_rank = dict(enumerate(workers))
+    poll_s = min(0.25, hang_timeout / 8) if hang_timeout else 0.25
     while any(p.poll() is None for p in workers):
         if cfg is not None:
             _respawn_dead_servers(servers, cfg)
-        stalled = wd.check(by_rank)
-        if stalled:
-            for rank, age, step in stalled:
-                print(f"watchdog: rank {rank} stalled "
-                      f"{age:.1f}s > {hang_timeout:.1f}s "
-                      f"(last step {step}) — collecting stack + "
-                      f"flight dumps, killing fleet")
-            rc = wd.fire(by_rank)
-            print(f"watchdog: fleet killed; post-mortem with "
-                  f"`python -m hetu_tpu.telemetry.blackbox {tdir}` "
-                  f"(exit code {rc})")
-            return rc
-        time.sleep(min(0.25, hang_timeout / 8))
+        if monitor is not None:
+            rep = monitor.poll()    # None between windows (throttled)
+            if rep is not None:
+                print(render_report(rep), flush=True)
+        if wd is not None:
+            stalled = wd.check(by_rank)
+            if stalled:
+                for rank, age, step in stalled:
+                    print(f"watchdog: rank {rank} stalled "
+                          f"{age:.1f}s > {hang_timeout:.1f}s "
+                          f"(last step {step}) — collecting stack + "
+                          f"flight dumps, killing fleet")
+                rc = wd.fire(by_rank)
+                if monitor is not None:
+                    # the window right before the kill is the evidence
+                    _finish_fleet_monitor(monitor, tdir)
+                print(f"watchdog: fleet killed; post-mortem with "
+                      f"`python -m hetu_tpu.telemetry.blackbox {tdir}` "
+                      f"(exit code {rc})")
+                return rc
+        time.sleep(poll_s)
+    if monitor is not None:
+        _finish_fleet_monitor(monitor, tdir)
     rc = 0
     for p in workers:
         rc = rc or p.returncode
@@ -551,7 +641,8 @@ def _clear_stale_blackbox(tdir):
     import glob as _glob
     for pat in ("hb_rank*.json", "flight_rank*.json", "stacks_*.log",
                 "oom_rank*.txt", "health_rank*.jsonl",
-                "health_lastgood_rank*.json"):
+                "health_lastgood_rank*.json", "timeline_rank*.jsonl",
+                "fleet_report.json", "trace_fleet.json"):
         for path in _glob.glob(os.path.join(tdir, pat)):
             try:
                 os.remove(path)
@@ -668,6 +759,15 @@ def main(argv=None):
                              "SPEC (e.g. '1' or "
                              "'every_n=5,action=dump'); post-mortem "
                              "with python -m hetu_tpu.telemetry.health")
+    parser.add_argument("--watch", action="store_true",
+                        help="arm the live fleet plane: per-rank step "
+                             "timelines + /fleet scrape endpoints, a "
+                             "launcher-side monitor printing a "
+                             "refreshing straggler/victim dashboard "
+                             "with CostDB drift verdicts, and "
+                             "fleet_report.json in the telemetry dir "
+                             "(post-hoc: python -m "
+                             "hetu_tpu.telemetry.fleet DIR)")
     parser.add_argument("--hang-timeout", type=float, default=None,
                         metavar="SECONDS",
                         help="arm the fleet watchdog: when any rank's "
@@ -692,7 +792,7 @@ def main(argv=None):
     return launch_command(cfg, args.command, args.identify,
                           telemetry=args.telemetry,
                           hang_timeout=args.hang_timeout,
-                          health=args.health)
+                          health=args.health, watch=args.watch)
 
 
 if __name__ == "__main__":

@@ -551,7 +551,8 @@ class CollectiveGPipe:
         with tel.span("cpp_dispatch", ticks=self.n_ticks, fill=fill,
                       drain=fill, fuse_ticks=self.fuse_ticks,
                       stages=S, microbatches=M,
-                      virtual_stages=self.V):
+                      virtual_stages=self.V,
+                      bytes=self.S_dev * self._row_bytes):
             out = self._step(tuple(stacked_params), tuple(opt_state),
                              feeds, base_rng, jnp.int32(step),
                              jnp.float32(lr))

@@ -246,13 +246,15 @@ class PSClient:
         assert rc == 0, f"StoreConfig({tid}) failed: {rc}"
 
     def store_stats(self, tid):
-        """Tiered-store counters summed across the table's shards."""
-        out = np.zeros(5, np.int64)
+        """Tiered-store counters summed across the table's shards;
+        ``repl_queue`` is the summed replication-forward backlog (0 on
+        unreplicated fleets — the fleet gauges read it live)."""
+        out = np.zeros(6, np.int64)
         rc = self.lib.StoreStats(tid, lptr(out), out.size)
         assert rc == 0, f"StoreStats({tid}) failed: {rc}"
         return {"dram_hits": int(out[0]), "spill_hits": int(out[1]),
                 "spill_writes": int(out[2]), "dram_rows": int(out[3]),
-                "row_bytes": int(out[4])}
+                "row_bytes": int(out[4]), "repl_queue": int(out[5])}
 
     # -- control --------------------------------------------------------
     def wait(self, tid):

@@ -1251,12 +1251,14 @@ int StoreConfig(int id, int dtype, int64_t dram_rows,
 }
 
 // aggregate tiered-store counters across one table's shards into
-// out[5] = {dram_hits, spill_hits, spill_writes, dram_rows, row_bytes}
+// out[6] = {dram_hits, spill_hits, spill_writes, dram_rows, row_bytes,
+// repl_queue} — repl_queue sums each shard server's replication-
+// forward backlog (fleet-wide lag signal; 0 unreplicated)
 int StoreStats(int id, int64_t* out, int64_t n) {
-  if (n < 5) return -1;
+  if (n < 6) return -1;
   auto& c = Client::Get();
   auto part = c.part(id);
-  int64_t acc[5] = {0, 0, 0, 0, 0};
+  int64_t acc[6] = {0, 0, 0, 0, 0, 0};
   for (int p = 0; p < part.nparts(); ++p) {
     Writer w;
     std::vector<uint8_t> resp;
@@ -1269,6 +1271,7 @@ int StoreStats(int id, int64_t* out, int64_t n) {
     acc[2] += static_cast<int64_t>(rd.u64());
     acc[3] += rd.i64();
     acc[4] = rd.i64();          // per-row bytes: identical on every shard
+    acc[5] += rd.i64();
   }
   std::memcpy(out, acc, sizeof acc);
   return 0;

@@ -996,6 +996,15 @@ class Server {
       case Op::kStoreStats: {
         Tensor* t = get(id);
         if (!t) return -1;
+        // replication backlog sampled BEFORE taking t->mu: the repl
+        // worker's repl_mu_ sections never take a tensor lock, and
+        // keeping the two disjoint here preserves that (no new lock
+        // order is introduced by this read-only stat)
+        size_t repl_depth = 0;
+        {
+          std::lock_guard<std::mutex> rl(repl_mu_);
+          repl_depth = repl_q_.size();
+        }
         std::shared_lock<std::shared_mutex> l(t->mu);
         TieredStore::Stats s;
         if (t->store)
@@ -1007,6 +1016,7 @@ class Server {
         out.u64(s.spill_writes);
         out.i64(s.dram_rows);
         out.i64(s.row_bytes);
+        out.i64(static_cast<int64_t>(repl_depth));
         return 0;
       }
       case Op::kShutdown:

@@ -274,6 +274,31 @@ class PSRuntime:
         if len(hot):
             self.client.store_config(tid, hot_ids=hot)
 
+    def _export_store_gauges(self):
+        """Live tiered/replicated PS gauges, refreshed on the drain
+        cadence (one kStoreStats round per tiered table every
+        push_bound steps — off the per-step path): per-table
+        ``ps_table_<tid>_spill_hit_rate`` / ``ps_table_<tid>_row_bytes``
+        and the fleet-wide ``ps_repl_queue_depth`` backlog. Gauges are
+        informational (the fleet timeline rides them into its records;
+        bench stamps stay the source of record for regress.py)."""
+        tel = self.config.telemetry
+        if not tel.enabled or not self._store_tids:
+            return
+        depth = 0
+        for tid in sorted(self._store_tids):
+            try:
+                st = self.client.store_stats(tid)
+            except AssertionError:
+                continue        # shard mid-failover: skip this window
+            hits = st["dram_hits"] + st["spill_hits"]
+            if hits:
+                tel.set_gauge(f"ps_table_{tid}_spill_hit_rate",
+                              st["spill_hits"] / hits)
+            tel.set_gauge(f"ps_table_{tid}_row_bytes", st["row_bytes"])
+            depth += st.get("repl_queue", 0)
+        tel.set_gauge("ps_repl_queue_depth", depth)
+
     def _register_device_table(self, entry):
         """Register a device-cached table on the server (kind=2 so the
         server keeps per-row versions for bounded-staleness sync)."""
@@ -537,6 +562,7 @@ class PSRuntime:
                 if rt.steps_since_drain >= rt.push_bound:
                     self._drain_device_table(rt, wait=self.config.bsp)
                     self._refresh_hot_rows(rt.tid)
+                    self._export_store_gauges()
 
         # 3. push PS grads / pull updated params
         track = self._track_push_tids
@@ -985,6 +1011,7 @@ class PSRuntime:
                 rt.note_step()
             if rt.steps_since_drain >= rt.push_bound:
                 self._drain_device_table(rt)
+                self._export_store_gauges()
         if self.config.ps_dense_cached and sub.training:
             self._dense_steps += nsteps
             if self._dense_steps >= max(1, self.config.cache_bound):

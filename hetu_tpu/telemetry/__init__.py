@@ -186,6 +186,16 @@ class Telemetry:
                 rpath = None
             if rpath:
                 self._flushed_paths.append(rpath)
+        # fleet step timeline (same sys.modules discipline: crash
+        # handlers must not import the fleet plane if nothing armed it)
+        fleet = sys.modules.get("hetu_tpu.telemetry.fleet")
+        if fleet is not None:
+            try:
+                tpath = fleet.dump_current(self.out_dir)
+            except Exception:   # noqa: BLE001 — never mask the crash
+                tpath = None
+            if tpath:
+                self._flushed_paths.append(tpath)
         return self._flushed_paths
 
 

@@ -22,7 +22,10 @@ rerun:
   the tensor id maps to, and whether that server was among the dead
   ranks;
 * **last completed step per rank** — the MegaScale-style straggler
-  view;
+  view; when the fleet plane was armed (``heturun --watch``), the
+  flushed ``timeline_rank<r>.jsonl`` files upgrade this to a measured
+  STRAGGLER line — which rank's own work was slow, by how much, and
+  which ranks were victims waiting on it (telemetry/fleet.py);
 * **training health** — when the run's health monitor left
   ``health_rank<r>.jsonl`` files (telemetry/health.py), the verdict
   also names the first bad step and the tripped layer/table, so a
@@ -220,6 +223,16 @@ def analyze(tdir):
     if not suspects and health and health.get("bad_ranks"):
         suspects = list(health["bad_ranks"])
 
+    # -- fleet straggler (timeline_rank<r>.jsonl, when present) ----------
+    fleet_sum = None
+    try:
+        from . import fleet as _fleet
+        fleet_sum = _fleet.summarize_for_blackbox(tdir)
+    except Exception:           # noqa: BLE001 — augmentation only
+        fleet_sum = None
+    if not suspects and fleet_sum:
+        suspects = [fleet_sum["straggler"]]
+
     # -- serving in-flight requests (requests_rank<r>.json) --------------
     serving_report = None
     if serving:
@@ -245,6 +258,7 @@ def analyze(tdir):
             "divergence": divergence,
             "waited_on_ranks": waited_on,
             "health": health,
+            "fleet": fleet_sum,
             "serving": serving_report,
             "suspect_ranks": suspects}
 
@@ -323,6 +337,17 @@ def format_report(rep):
                 f"on rank {health['bad_rank']} ({what}{where}) — "
                 f"`python -m hetu_tpu.telemetry.health {rep['dir']}` "
                 f"for the ranked causes")
+    fleet = rep.get("fleet")
+    if fleet:
+        lines.append(
+            f"  STRAGGLER rank {fleet['straggler']} at step "
+            f"{fleet['step']}: self {fleet['self_ms']}ms "
+            f"({fleet['skew_ms']}ms over the fleet median, top bucket "
+            f"{fleet['top_bucket']!r})"
+            + (f"; victims (grown wait): {fleet['victims']}"
+               if fleet.get("victims") else "")
+            + f" — `python -m hetu_tpu.telemetry.fleet {rep['dir']}` "
+              f"for the full table")
     serving = rep.get("serving")
     if serving:
         for key in sorted(serving, key=int):

@@ -96,7 +96,19 @@ SPAN_SCHEMA = {
     "cpp_dispatch": {"ticks": _req(_INT), "fill": _opt(_INT),
                      "drain": _opt(_INT), "fuse_ticks": _opt(_INT),
                      "stages": _opt(_INT), "microbatches": _opt(_INT),
-                     "virtual_stages": _opt(_INT)},
+                     "virtual_stages": _opt(_INT), "bytes": _opt(_INT)},
+    # fleet monitor (telemetry/fleet.py): one fleet_watch span per
+    # monitor poll (straggler attribution over the aligned step window),
+    # one "drift" instant per CostDB drift verdict that tripped — both
+    # strictly typed, no open payload (the post-hoc CLI and CI assert on
+    # these fields).
+    "fleet_watch": {"step": _req(_INT), "straggler": _opt(_INT),
+                    "skew_ms": _req(_NUM), "victims": _opt(_INT),
+                    "aligned": _opt(_BOOL), "ranks": _opt(_INT)},
+    "drift": {"rank": _req(_INT), "kind": _req(_STR),
+              "bytes": _opt(_INT), "measured_ms": _req(_NUM),
+              "predicted_ms": _req(_NUM), "windows": _req(_INT),
+              "tripped": _opt(_BOOL), "source": _opt(_STR)},
     # training health monitor (telemetry/health.py): one "health" span
     # per sampled check, one "health_trip" instant per ladder firing
     "health": {"step": _req(_INT), "layers": _opt(_INT),

@@ -129,6 +129,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._server = None
         self._server_thread = None
+        # optional callable returning a JSON-able dict served at /fleet
+        # (the fleet StepTimeline installs its recent-window payload
+        # here so the launcher's FleetMonitor can scrape it live)
+        self.fleet_source = None
 
     def _get(self, name, cls, **kw):
         with self._lock:
@@ -152,6 +156,24 @@ class MetricsRegistry:
 
     def histogram(self, name, max_samples=4096):
         return self._get(name, Histogram, max_samples=max_samples)
+
+    def peek(self, name):
+        """Current value of a metric if it exists, else None — a read
+        that never creates (the fleet timeline samples PS gauges this
+        way without registering them on ranks that have no PS)."""
+        with self._lock:
+            m = self._metrics.get(name)
+        return getattr(m, "value", None)   # histograms have no scalar
+
+    def names(self):
+        """Registered metric names (snapshot)."""
+        with self._lock:
+            return list(self._metrics)
+
+    @property
+    def serving(self):
+        """True while the HTTP scrape server is up."""
+        return self._server is not None
 
     def snapshot(self):
         with self._lock:
@@ -211,6 +233,17 @@ class MetricsRegistry:
                 elif path in ("", "/metrics"):
                     self._reply(registry.to_prometheus().encode(),
                                 "text/plain; version=0.0.4")
+                elif path == "/fleet":
+                    src = registry.fleet_source
+                    if src is None:
+                        self.send_error(404)
+                    else:
+                        try:
+                            body = json.dumps(src()).encode()
+                        except Exception:
+                            self.send_error(500)
+                            return
+                        self._reply(body, "application/json")
                 else:
                     self.send_error(404)
 

@@ -84,6 +84,25 @@ class Tracer:
         self._events.append(
             (name, "i", _clock(), 0, threading.get_ident(), args or None))
 
+    def events_between(self, t0_ns, t1_ns):
+        """Raw complete events whose END falls in ``[t0_ns, t1_ns]``
+        (span clock), newest-window reads in O(window): events append
+        at completion time, so the ring is end-time ordered and a
+        reversed walk can stop at the first event older than the
+        window — the fleet timeline's per-step incremental read.
+        Returns ``(name, t0_ns, dur_ns, thread_ident, args)`` tuples
+        in completion order."""
+        out = []
+        with self._lock:
+            for name, ph, et0, dur, ident, args in reversed(self._events):
+                end = et0 + dur
+                if end < t0_ns:
+                    break
+                if ph == "X" and end <= t1_ns:
+                    out.append((name, et0, dur, ident, args))
+        out.reverse()
+        return out
+
     # -- export ----------------------------------------------------------
     def _tid_of(self, ident):
         ent = self._tids.get(ident)

@@ -2,8 +2,10 @@
 turns an eternal hang into a diagnosed failure.
 
 Worker side (:class:`Heartbeat`): each executor writes a tiny JSON file
-``hb_rank<r>.json`` — rank, pid, step counter, wall clock — at step
-boundaries, throttled to at most one write per ``interval`` seconds,
+``hb_rank<r>.json`` — rank, pid, step counter, wall clock, plus a
+step-time EMA and the top exposed bucket when the executor feeds them
+(fleet.py) — at step boundaries, throttled to at most one write per
+``interval`` seconds (step changes force a write after a short floor),
 and marks it ``done`` on clean close. Enabled by the launcher exporting
 ``HETU_WATCHDOG_DIR`` (``heturun --hang-timeout``); with the env unset
 the executor holds no Heartbeat at all, so the disabled path costs one
@@ -46,12 +48,19 @@ class Heartbeat:
         self.path = os.path.join(out_dir, f"hb_rank{self.rank}.json")
         self._last_write = 0.0
         self._step = 0
+        self._step_ms_ema = None
+        self._top_bucket = None
         os.makedirs(out_dir, exist_ok=True)
         self._write(done=False)         # boot beat: pid discoverable
 
     def _write(self, done):
         doc = {"rank": self.rank, "pid": os.getpid(),
-               "step": self._step, "time": time.time(), "done": done}
+               "step": self._step, "last_step": self._step,
+               "time": time.time(), "done": done}
+        if self._step_ms_ema is not None:
+            doc["step_ms_ema"] = round(self._step_ms_ema, 3)
+        if self._top_bucket is not None:
+            doc["top_bucket"] = self._top_bucket
         tmp = f"{self.path}.{os.getpid()}.tmp"
         try:
             with open(tmp, "w") as f:
@@ -61,11 +70,23 @@ class Heartbeat:
         except OSError:
             pass                        # liveness is best effort
 
-    def beat(self, step=None):
-        """Record progress; writes at most once per ``interval``."""
-        if step is not None:
+    def beat(self, step=None, step_ms=None, top_bucket=None):
+        """Record progress; writes at most once per ``interval``, except
+        that a *step change* forces a write after a much shorter floor —
+        the FleetMonitor aligns ranks by step index, so a heartbeat
+        frozen a full interval behind would smear the skew signal."""
+        stepped = False
+        if step is not None and int(step) != self._step:
             self._step = int(step)
-        if time.monotonic() - self._last_write >= self.interval:
+            stepped = True
+        if step_ms is not None:
+            e = self._step_ms_ema
+            self._step_ms_ema = (float(step_ms) if e is None
+                                 else 0.8 * e + 0.2 * float(step_ms))
+        if top_bucket is not None:
+            self._top_bucket = top_bucket
+        floor = min(0.05, self.interval) if stepped else self.interval
+        if time.monotonic() - self._last_write >= floor:
             self._write(done=False)
 
     def done(self):

@@ -136,7 +136,8 @@ def pytest_runtest_makereport(item, call):
     patterns = ("trace_*.json", "flight_rank*.json", "hb_rank*.json",
                 "stacks_*.log", "metrics_rank*.jsonl", "oom_rank*.txt",
                 "health_rank*.jsonl", "health_lastgood_rank*.json",
-                "lockgraph_*.json", "rangedb_*.json")
+                "lockgraph_*.json", "rangedb_*.json",
+                "timeline_rank*.jsonl", "fleet_report.json")
     found = []
     for pat in patterns:
         found += glob.glob(os.path.join(str(tmp), "**", pat),

@@ -9,7 +9,9 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 # env a previous fleet (or the surrounding pytest process) may have
 # exported; a leaked value silently rewires the next fleet
 _FLEET_VARS = ("HETU_PS_HOSTS", "HETU_PS_PORTS", "HETU_COORDINATOR",
-               "HETU_NUM_PROCS", "HETU_PROC_ID")
+               "HETU_NUM_PROCS", "HETU_PROC_ID", "HETU_FLEET",
+               "HETU_METRICS_PORT", "HETU_FAULT_SLOW_RANK",
+               "HETU_FAULT_SLOW_MS", "HETU_WATCHDOG_DIR")
 
 
 def clean_launcher_env(**extra):

@@ -364,8 +364,11 @@ def _producer_fixture_tracer():
     span("p2p_send", tag="t", dst=1, bytes=128)
     span("p2p_recv", tag="t", bytes=128)
     span("cpp_dispatch", ticks=5, fill=1, drain=1, fuse_ticks=2,
-         stages=2, microbatches=4)
+         stages=2, microbatches=4, bytes=4096)
     span("cpp_pack_feeds", bytes=512)
+    span("fleet_watch", step=12, straggler=1, skew_ms=15.5, victims=2,
+         aligned=True, ranks=3)
+    span("fleet_watch", step=-1, straggler=None, skew_ms=0.0, victims=0)
     span("health", step=10, layers=3, trips=1)
     span("autotune_sweep", kernel="flash_fwd", key="cpu|flash|128",
          chosen="(128, 128)", picked_ms=1.2,
@@ -379,6 +382,9 @@ def _producer_fixture_tracer():
                value=3.0, limit=0)
     tr.instant("health_trip", step=20, kind="staleness", table="7",
                value=9.0, limit=4.0)
+    tr.instant("drift", rank=1, kind="p2p", bytes=1 << 20,
+               measured_ms=10.0, predicted_ms=0.4, windows=3,
+               tripped=True, source="measured")
     return tr
 
 
@@ -402,6 +408,12 @@ def test_schema_accepts_every_producer_fixture(tmp_path):
                         "picked_ms": "fast", "candidates_ms": {}},
      "picked_ms"),
     ("cpp_dispatch", {"fill": 1}, "ticks"),
+    # fleet watch / drift (telemetry/fleet.py)
+    ("fleet_watch", {"skew_ms": 0.0}, "missing"),
+    ("fleet_watch", {"step": 1, "skew_ms": "big"}, "skew_ms"),
+    ("drift", {"rank": 0, "kind": "p2p", "measured_ms": 1.0,
+               "predicted_ms": 0.5, "windows": 1, "tripped": 1},
+     "tripped"),
 ])
 def test_schema_rejects_drifted_attrs(tmp_path, name, args, match):
     tr = Tracer(pid=0)

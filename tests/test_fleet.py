@@ -1,0 +1,540 @@
+"""Fleet watch (telemetry/fleet.py): straggler/victim attribution math,
+step alignment across restarts and ragged starts, the worker-side
+StepTimeline (incremental doctor-style bucket claiming), the CostDB
+drift detector (runtime HT910), the post-hoc CLI, and the 2-process
+GPipe dryrun acceptance: an injected slow rank is named by both the
+live monitor (fleet_report.json) and `python -m hetu_tpu.telemetry.fleet`.
+"""
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.executor import Executor
+from hetu_tpu.telemetry import NULL, Telemetry
+from hetu_tpu.telemetry import fleet
+from hetu_tpu.telemetry.costdb import CostDB, pow2_bucket
+from hetu_tpu.telemetry.watchdog import Heartbeat
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet_global(monkeypatch):
+    """Tests that arm timeline_from_env set the module-global crash-dump
+    target; never leak it into later tests."""
+    monkeypatch.setattr(fleet, "_current", None)
+
+
+def _rec(step, wall, buckets=None, comm_bytes=None, steps=1, t=None):
+    rec = {"step": step, "t": float(step if t is None else t),
+           "wall_ms": float(wall), "steps": steps,
+           "buckets": dict(buckets or {})}
+    if comm_bytes:
+        rec["comm_bytes"] = dict(comm_bytes)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# straggler / victim math (pure units)
+# ---------------------------------------------------------------------------
+
+def test_rank_stats_normalizes_block_records_by_steps():
+    st = fleet.rank_stats(_rec(10, 1000.0, {"compute": 600.0,
+                                            "collective": 400.0},
+                               steps=100))
+    assert st["wall_ms"] == 10.0
+    assert st["wait_ms"] == 4.0
+    assert st["self_ms"] == 6.0
+    assert st["top_bucket"] == "compute"
+
+
+def test_attribute_skew_names_straggler_and_victims():
+    # rank 1 does 25ms of its own work vs ~10ms baseline; ranks 0/2
+    # wait on the collective to cover it -> victims, not stragglers
+    window = {
+        0: _rec(5, 26.0, {"compute": 10.0, "collective": 16.0}),
+        1: _rec(5, 26.0, {"compute": 25.0, "collective": 1.0}),
+        2: _rec(5, 26.0, {"compute": 11.0, "collective": 15.0}),
+    }
+    out = fleet.attribute_skew(window)
+    assert out["straggler"] == 1
+    assert out["skew_ms"] == pytest.approx(25.0 - 10.5, abs=0.01)
+    assert out["victims"] == [0, 2]
+
+
+def test_attribute_skew_jitter_below_threshold_names_nobody():
+    # 0.5ms of jitter on a 10ms step: under both the 2ms floor and
+    # 20% of the median wall — a healthy fleet gets no accusation
+    window = {0: _rec(3, 10.0, {"compute": 10.0}),
+              1: _rec(3, 10.5, {"compute": 10.5})}
+    out = fleet.attribute_skew(window)
+    assert out["straggler"] is None and out["victims"] == []
+    # single-rank windows can't skew
+    assert fleet.attribute_skew({0: _rec(3, 10.0)})["straggler"] is None
+
+
+def test_align_windows_picks_newest_common_step():
+    tls = {0: [_rec(s, 10.0) for s in range(1, 6)],
+           1: [_rec(s, 10.0) for s in range(3, 8)]}
+    step, window, aligned = fleet.align_windows(tls)
+    assert aligned and step == 5
+    assert sorted(window) == [0, 1]
+    assert all(r["step"] == 5 for r in window.values())
+
+
+def test_align_windows_restart_latest_record_wins():
+    # rank 0 restarted and re-ran step 4: the later record (larger t)
+    # must win the alignment
+    tls = {0: [_rec(4, 50.0, t=1.0), _rec(4, 12.0, t=9.0)],
+           1: [_rec(4, 11.0, t=5.0)]}
+    step, window, aligned = fleet.align_windows(tls)
+    assert aligned and step == 4
+    assert window[0]["wall_ms"] == 12.0
+
+
+def test_align_windows_ragged_degrades_to_latest():
+    tls = {0: [_rec(1, 10.0), _rec(2, 10.0)],
+           1: [_rec(10, 11.0), _rec(11, 12.0)]}
+    step, window, aligned = fleet.align_windows(tls)
+    assert not aligned and step == -1
+    assert window[0]["step"] == 2 and window[1]["step"] == 11
+    assert fleet.align_windows({}) == (-1, {}, False)
+
+
+# ---------------------------------------------------------------------------
+# StepTimeline: incremental bucket claiming + dump/load round-trip
+# ---------------------------------------------------------------------------
+
+def test_timeline_attributes_window_buckets_and_bytes():
+    tel = Telemetry(enabled=True, rank=0)
+    base = 1_000_000_000
+    # 5ms dispatch + 3ms p2p inside a 10ms window; an overlapped span
+    # is accounted as hidden, never charged against the wall
+    tel.complete("device_dispatch", base + 1_000_000, base + 6_000_000)
+    tel.complete("p2p_recv", base + 6_000_000, base + 9_000_000,
+                 {"bytes": 2048})
+    tel.complete("p2p_send", base + 2_000_000, base + 4_000_000,
+                 {"bytes": 512, "overlapped": True})
+    tl = fleet.StepTimeline(tel, rank=0)
+    rec = tl.on_step(7, base, base + 10_000_000, 10.0)
+    assert rec["buckets"]["compute"] == pytest.approx(5.0)
+    assert rec["buckets"]["p2p"] == pytest.approx(3.0)
+    assert rec["buckets"]["unaccounted"] == pytest.approx(2.0)
+    # byte accounting still sees the hidden send (it moved real bytes)
+    assert rec["comm_bytes"] == {"p2p": 2048 + 512}
+    assert rec["hidden_ms"] == pytest.approx(2.0)
+    assert tl.summary() == (10.0, "compute")
+    doc = tl.fleet_json()
+    assert doc["rank"] == 0 and doc["records"][-1]["step"] == 7
+
+
+def test_timeline_dump_load_roundtrip(tmp_path):
+    tel = Telemetry(enabled=True, rank=3)
+    tl = fleet.StepTimeline(tel, rank=3, out_dir=str(tmp_path),
+                            capacity=4)
+    base = 1_000_000_000
+    for s in range(6):          # 6 records through a 4-slot ring
+        tl.on_step(s, base, base, 5.0 + s)
+    assert tl.dump() == str(tmp_path / "timeline_rank3.jsonl")
+    loaded = fleet.load_timelines(str(tmp_path))
+    assert list(loaded) == [3]
+    # ring kept only the newest 4
+    assert [r["step"] for r in loaded[3]] == [2, 3, 4, 5]
+    # a torn half-written tail is skipped, not fatal
+    with open(tmp_path / "timeline_rank3.jsonl", "a") as f:
+        f.write('{"step": 99, "wall')
+    assert [r["step"] for r in fleet.load_timelines(str(tmp_path))[3]] \
+        == [2, 3, 4, 5]
+
+
+def test_timeline_from_env_gating(tmp_path, monkeypatch):
+    monkeypatch.delenv("HETU_FLEET", raising=False)
+    tel = Telemetry(enabled=True, out_dir=str(tmp_path), rank=0)
+    assert fleet.timeline_from_env(tel) is None
+    monkeypatch.setenv("HETU_FLEET", "1")
+    assert fleet.timeline_from_env(NULL) is None       # telemetry off
+    assert fleet.timeline_from_env(
+        Telemetry(enabled=True, rank=0)) is None       # no out_dir
+    tl = fleet.timeline_from_env(tel)
+    assert isinstance(tl, fleet.StepTimeline)
+    base = 1_000_000_000
+    tl.on_step(1, base, base, 4.0)
+    # the crash handlers reach the live timeline through the module
+    # global, no imports
+    assert fleet.dump_current() == str(tmp_path / "timeline_rank0.jsonl")
+
+
+def test_fault_slow_from_env(monkeypatch):
+    monkeypatch.delenv("HETU_FAULT_SLOW_RANK", raising=False)
+    monkeypatch.delenv("HETU_PROC_ID", raising=False)
+    assert fleet.fault_slow_from_env() == 0.0
+    monkeypatch.setenv("HETU_FAULT_SLOW_RANK", "1")
+    assert fleet.fault_slow_from_env() == 0.0          # we are rank 0
+    monkeypatch.setenv("HETU_PROC_ID", "1")
+    monkeypatch.setenv("HETU_FAULT_SLOW_MS", "80")
+    assert fleet.fault_slow_from_env() == pytest.approx(0.08)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat enrichment (satellite: watchdog.py)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_enrichment_fields(tmp_path):
+    hb = Heartbeat(str(tmp_path), rank=0, interval=0.01)
+    time.sleep(0.02)
+    hb.beat(step=1, step_ms=10.0, top_bucket="compute")
+    doc = json.load(open(tmp_path / "hb_rank0.json"))
+    assert doc["last_step"] == 1 and doc["step"] == 1
+    assert doc["step_ms_ema"] == 10.0
+    assert doc["top_bucket"] == "compute"
+    time.sleep(0.02)
+    hb.beat(step=2, step_ms=20.0, top_bucket="collective")
+    doc = json.load(open(tmp_path / "hb_rank0.json"))
+    assert doc["step_ms_ema"] == pytest.approx(0.8 * 10 + 0.2 * 20)
+    assert doc["top_bucket"] == "collective"
+
+
+def test_heartbeat_step_change_forces_write_within_floor(tmp_path):
+    hb = Heartbeat(str(tmp_path), rank=1, interval=30.0)
+    time.sleep(0.06)            # past the 0.05s stepped floor
+    hb.beat(step=7, step_ms=5.0)
+    doc = json.load(open(tmp_path / "hb_rank1.json"))
+    assert doc["step"] == 7, \
+        "a step change must not wait out the full 30s interval"
+
+
+# ---------------------------------------------------------------------------
+# drift detector: runtime HT910 on poisoned vs honest CostDB
+# ---------------------------------------------------------------------------
+
+NBYTES = 1 << 20
+
+
+def _db(tmp_path, name, ms):
+    db = CostDB(str(tmp_path / name))
+    db.record("p2p", pow2_bucket(NBYTES), "bytes", ms, nbytes=NBYTES)
+    return db
+
+
+def test_drift_trips_on_poisoned_db_after_k_windows(tmp_path):
+    # DB claims 0.4ms for a transfer that measures 10ms: exceeded
+    # (10 > 3 x 0.4 + 0.5), and the trip fires on the k-th consecutive
+    # window, once
+    det = fleet.DriftDetector(db=_db(tmp_path, "bad.json", 0.4), k=3)
+    for i in range(3):
+        v = det.observe(1, "p2p", NBYTES, 10.0)
+        assert v["exceeded"] and v["windows"] == i + 1
+        assert v["tripped"] == (i == 2)
+    assert det.tripped and len(det.trips) == 1
+    assert det.trips[0]["rank"] == 1 and det.trips[0]["kind"] == "p2p"
+    det.observe(1, "p2p", NBYTES, 10.0)
+    assert len(det.trips) == 1, "a (rank, kind) trip fires once"
+
+
+def test_drift_honest_db_and_recovery_stay_clean(tmp_path):
+    det = fleet.DriftDetector(db=_db(tmp_path, "good.json", 9.0), k=3)
+    for _ in range(5):
+        v = det.observe(0, "p2p", NBYTES, 10.0)
+        assert not v["exceeded"]        # 10 < 3 x 9 + 0.5
+    assert not det.tripped
+    # a single healthy window resets the consecutive counter
+    det2 = fleet.DriftDetector(db=_db(tmp_path, "bad2.json", 0.4), k=3)
+    det2.observe(0, "p2p", NBYTES, 10.0)
+    det2.observe(0, "p2p", NBYTES, 10.0)
+    det2.observe(0, "p2p", NBYTES, 0.5)     # recovered window
+    det2.observe(0, "p2p", NBYTES, 10.0)
+    assert not det2.tripped
+
+
+def test_drift_skips_unmeasured_kinds(tmp_path):
+    # empty DB: cold-start heuristics are NOT drift baselines
+    det = fleet.DriftDetector(db=CostDB(str(tmp_path / "empty.json")))
+    assert det.observe(0, "p2p", NBYTES, 50.0) is None
+    assert det.observe(0, "p2p", 0, 50.0) is None       # no bytes moved
+
+
+# ---------------------------------------------------------------------------
+# FleetMonitor over flushed files + /fleet endpoint + post-hoc CLI
+# ---------------------------------------------------------------------------
+
+def _write_fleet_dir(tmp_path, slow_rank=1, steps=4, drift=False):
+    """3-rank timelines with one fat-self rank; optional p2p traffic
+    for the drift detector."""
+    for r in range(3):
+        with open(tmp_path / f"timeline_rank{r}.jsonl", "w") as f:
+            for s in range(steps):
+                self_ms = 25.0 if r == slow_rank else 10.0
+                rec = _rec(s, 27.0, {"compute": self_ms,
+                                     "collective": 27.0 - self_ms - 2.0,
+                                     "p2p": 2.0},
+                           comm_bytes={"p2p": NBYTES} if drift else None,
+                           t=s + r * 0.001)
+                f.write(json.dumps(rec) + "\n")
+
+
+def test_monitor_names_straggler_from_disk(tmp_path):
+    _write_fleet_dir(tmp_path)
+    out = str(tmp_path / "fleet_report.json")
+    mon = fleet.FleetMonitor(str(tmp_path), num_workers=3, interval=0.0,
+                             out_path=out)
+    rep = mon.poll(force=True)
+    assert rep["straggler"] == 1 and rep["aligned"]
+    assert rep["victims"] == [0, 2]
+    assert json.load(open(out))["straggler"] == 1
+    text = fleet.render_report(rep)
+    assert "STRAGGLER" in text and "victim" in text
+
+
+def test_monitor_throttles_between_windows(tmp_path):
+    _write_fleet_dir(tmp_path)
+    mon = fleet.FleetMonitor(str(tmp_path), num_workers=3,
+                             interval=60.0)
+    assert mon.poll(force=True) is not None
+    assert mon.poll() is None, "inside the interval: cached, no rescan"
+
+
+def test_monitor_heartbeat_only_rank_contributes_skew(tmp_path):
+    # rank 2 never flushed a timeline (no metrics port, died early) but
+    # its enriched heartbeat still carries the skew signal
+    _write_fleet_dir(tmp_path)
+    os.remove(tmp_path / "timeline_rank2.jsonl")
+    with open(tmp_path / "hb_rank2.json", "w") as f:
+        json.dump({"rank": 2, "pid": 1, "step": 3, "last_step": 3,
+                   "time": time.time(), "done": False,
+                   "step_ms_ema": 27.0, "top_bucket": "collective"}, f)
+    rep = fleet.FleetMonitor(str(tmp_path), num_workers=3,
+                             interval=0.0).poll(force=True)
+    row = rep["ranks"]["2"]
+    assert row["step_ms"] == 27.0
+    assert row["top_bucket"] == "collective"
+
+
+def test_monitor_drift_poisoned_vs_honest(tmp_path):
+    _write_fleet_dir(tmp_path, drift=True)
+    rep = fleet.analyze_dir(str(tmp_path),
+                            costdb=_db(tmp_path, "bad.json", 0.1),
+                            drift_k=3)
+    assert rep["drift_trips"], "poisoned CostDB must trip"
+    trip = rep["drift_trips"][0]
+    assert trip["kind"] == "p2p" and trip["windows"] >= 3
+    assert any(v["drift"] == "DRIFT" for v in rep["ranks"].values())
+    rep = fleet.analyze_dir(str(tmp_path),
+                            costdb=_db(tmp_path, "good.json", 2.0),
+                            drift_k=3)
+    assert not rep["drift_trips"], "honest CostDB must stay clean"
+    assert "DRIFT" in fleet.render_report(
+        fleet.analyze_dir(str(tmp_path),
+                          costdb=_db(tmp_path, "bad2.json", 0.1)))
+
+
+def test_fleet_endpoint_serves_timeline(tmp_path):
+    from hetu_tpu.ps.server import pick_free_port
+    tel = Telemetry(enabled=True, rank=0)
+    reg = tel.metrics
+    port = pick_free_port()
+    reg.serve(port)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=5)
+        assert exc.value.code == 404       # no timeline installed yet
+        tl = fleet.StepTimeline(tel, rank=0)
+        base = 1_000_000_000
+        tl.on_step(2, base, base + 5_000_000, 5.0)
+        reg.fleet_source = tl.fleet_json
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=5) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["rank"] == 0
+        assert doc["records"][-1]["step"] == 2
+    finally:
+        reg.shutdown()
+    assert not reg.serving
+
+
+def test_posthoc_cli(tmp_path, capsys):
+    _write_fleet_dir(tmp_path)
+    assert fleet.main([str(tmp_path), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["straggler"] == 1
+    assert fleet.main([str(tmp_path)]) == 0
+    assert "STRAGGLER" in capsys.readouterr().out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert fleet.main([str(empty)]) == 2
+
+
+def test_blackbox_summary_line(tmp_path):
+    _write_fleet_dir(tmp_path)
+    s = fleet.summarize_for_blackbox(str(tmp_path))
+    assert s["straggler"] == 1 and s["victims"] == [0, 2]
+    # a single-rank dir has no fleet to skew against
+    for r in (1, 2):
+        os.remove(tmp_path / f"timeline_rank{r}.jsonl")
+    assert fleet.summarize_for_blackbox(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# overhead contract (PRs 2/4/9/17 discipline)
+# ---------------------------------------------------------------------------
+
+def test_disabled_fleet_zero_allocations(monkeypatch):
+    """No --watch: timeline_from_env returns None and the executor's
+    per-step branch is one `is None` check — zero allocations."""
+    monkeypatch.delenv("HETU_FLEET", raising=False)
+    tl = fleet.timeline_from_env(NULL)
+    fault = fleet.fault_slow_from_env()
+    assert tl is None
+    gc.collect()
+    gc.disable()
+    try:
+        before = sys.getallocatedblocks()
+        for _ in range(5000):
+            # the executor's disabled per-step path, verbatim
+            if tl is not None:
+                tl.on_step(0, 0, 0, 0.0)
+            if fault:
+                time.sleep(fault)
+        after = sys.getallocatedblocks()
+    finally:
+        gc.enable()
+    assert after - before <= 8, \
+        f"disabled fleet path allocated {after - before} blocks"
+
+
+def test_enabled_timeline_overhead_under_1pct():
+    """Enabled path: one on_step per step; bound its cost against a
+    measured real step, the PR 2 span-guard method."""
+    rng = np.random.RandomState(0)
+    x = ht.Variable("fl_x", trainable=False)
+    y_ = ht.Variable("fl_y", trainable=False)
+    w1 = ht.init.xavier_normal((3072, 1024), name="fl_w1")
+    w2 = ht.init.xavier_normal((1024, 10), name="fl_w2")
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y_), [0])
+    train = ht.optim.SGDOptimizer(0.1).minimize(loss)
+    exe = Executor([loss, train])
+    feeds = {x: rng.randn(128, 3072).astype("f"),
+             y_: np.eye(10, dtype="f")[rng.randint(0, 10, 128)]}
+    for _ in range(3):
+        exe.run(feed_dict=feeds)
+    times = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        out = exe.run(feed_dict=feeds)
+        out[0].asnumpy()
+        times.append(time.perf_counter() - t0)
+    step_ms = float(np.median(times)) * 1000
+
+    tel = Telemetry(enabled=True, rank=0)
+    base = 1_000_000_000
+    tel.complete("device_dispatch", base + 1_000_000, base + 6_000_000)
+    tel.complete("p2p_recv", base + 6_000_000, base + 9_000_000,
+                 {"bytes": 2048})
+    tl = fleet.StepTimeline(tel, rank=0)     # no out_dir: no I/O
+    n = 5000
+    t0 = time.perf_counter()
+    for i in range(n):
+        tl.on_step(i, base, base + 10_000_000, 10.0)
+    per_step_ms = (time.perf_counter() - t0) / n * 1000
+    assert per_step_ms < 0.01 * step_ms, (per_step_ms, step_ms)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2-process GPipe dryrun with an injected slow rank
+# ---------------------------------------------------------------------------
+
+SPMD_CONFIG = """
+spmd: true
+nodes:
+  - host: localhost
+    workers: 2
+    chief: true
+"""
+
+SPMD_PP_WORKER = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from hetu_tpu.executor import Executor, maybe_init_distributed
+maybe_init_distributed()
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+import hetu_tpu as ht
+
+rank = int(os.environ["HETU_PROC_ID"])
+rng = np.random.RandomState(0)
+w1v = rng.randn(12, 16).astype("f") * 0.3
+w2v = rng.randn(16, 4).astype("f") * 0.3
+with ht.context(ht.rcpu("worker0", 0)):
+    x = ht.Variable("x", trainable=False)
+    w1 = ht.Variable("w1", value=w1v)
+    a = ht.relu_op(ht.matmul_op(x, w1))
+with ht.context(ht.rcpu("worker1", 0)):
+    w2 = ht.Variable("w2", value=w2v)
+    y_ = ht.Variable("y_", trainable=False)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(a, w2), y_), [0])
+    train_op = ht.optim.SGDOptimizer(0.2).minimize(loss)
+exe = Executor([loss, train_op], gpipe=True, num_microbatches=4)
+frng = np.random.RandomState(3)
+xs = frng.randn(32, 12).astype("f")
+ys = np.eye(4, dtype="f")[frng.randint(0, 4, 32)]
+for _ in range(8):
+    exe.run(feed_dict={x: xs, y_: ys})
+exe.close()
+"""
+
+
+def test_watch_dryrun_names_slow_rank(tmp_path):
+    """heturun --watch on a 2-process GPipe fleet with rank 1 slowed
+    by HETU_FAULT_SLOW_RANK: the live monitor's fleet_report.json AND
+    the post-hoc CLI must both name rank 1."""
+    from launcher_util import clean_launcher_env
+    cfg_path = tmp_path / "spmd.yml"
+    cfg_path.write_text(SPMD_CONFIG)
+    script = tmp_path / "pp_worker.py"
+    script.write_text(SPMD_PP_WORKER)
+    tdir = tmp_path / "tel"
+    env = clean_launcher_env(
+        HETU_TEST_OUT=str(tmp_path),
+        HETU_FAULT_SLOW_RANK="1",
+        HETU_FAULT_SLOW_MS="120",
+        HETU_WATCH_INTERVAL="0.5",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "hetu_tpu.launcher", "-c", str(cfg_path),
+         "--telemetry", str(tdir), "--watch", "--hang-timeout", "120",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # (a) the live monitor persisted its last window
+    rep = json.load(open(tdir / "fleet_report.json"))
+    assert rep["straggler"] == 1, (rep, proc.stdout)
+    assert rep["skew_ms"] > 50, rep
+    # the live dashboard printed the attribution at least once
+    assert "STRAGGLER" in proc.stdout, proc.stdout
+
+    # (b) both ranks flushed step timelines
+    for r in range(2):
+        assert (tdir / f"timeline_rank{r}.jsonl").exists(), proc.stdout
+
+    # (c) post-hoc CLI over the flushed files agrees
+    cli = subprocess.run(
+        [sys.executable, "-m", "hetu_tpu.telemetry.fleet", str(tdir),
+         "--json"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert cli.returncode == 0, cli.stdout + cli.stderr
+    assert json.loads(cli.stdout)["straggler"] == 1
