@@ -28,7 +28,8 @@ from .bert import (BertLayerNorm as LayerNorm, Dropout, Embedding,
 __all__ = ["GPTConfig", "GPTModel", "GPTLMHeadModel",
            "gpt_param_names", "gpt_serving_params", "init_kv_cache",
            "gpt_prefill", "gpt_cached_step",
-           "gpt_paged_prefill", "gpt_paged_step"]
+           "gpt_paged_prefill", "gpt_paged_step",
+           "gpt_paged_suffix_prefill"]
 
 
 class GPTConfig:
@@ -287,6 +288,57 @@ def gpt_paged_step(params, pools, tokens, positions, slot_idx,
                                      positions,
                                      sm_scale=1.0 / float(np.sqrt(hs)))
         x = x + (ctx.reshape(b, hidden) @ blk["proj"][0] + blk["proj"][1])
+        x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
+    x = _serve_ln(x, params["ln_f"])
+    return x @ params["lm_head"], new_pools
+
+
+def gpt_paged_suffix_prefill(params, pools, ids, starts, slot_idx,
+                             write_slots, num_heads, hidden_act="gelu"):
+    """Prefill a CHUNK of prompt positions into an existing block table:
+    ``ids`` ``[B, C]`` are each sequence's next ``C`` prompt tokens
+    starting at token offset ``starts`` ``[B]`` (traced int32 — one jit
+    program per batch/chunk/context bucket serves every offset mix).
+    This is both halves of the prefix story: a prefix-cache hit starts
+    prefill at the first non-cached position with the cached blocks
+    already resident in ``slot_idx``'s grid, and chunked prefill feeds
+    a long prompt through here one chunk per engine step.
+
+    Each chunk row's K/V scatters to flat pool slot ``write_slots``
+    ``[B, C]`` and attention gathers the whole history (cached prefix +
+    earlier chunks + this chunk) through the slot grid ``slot_idx``
+    ``[B, S_bucket]`` via
+    :func:`~hetu_tpu.ops.attention.paged_prefill_attention` (causality:
+    chunk row ``i`` sees positions ``<= starts[b] + i``). Padded lanes
+    write to scratch and rows past a chunk's true width are edge
+    padding, same contract as :func:`gpt_paged_prefill`. Returns
+    ``(logits [B, C, V], pools)``; jit with ``pools`` donated."""
+    from ..ops.attention import paged_prefill_attention
+
+    act = _serve_act(hidden_act)
+    b, c = ids.shape
+    hidden = params["wte"].shape[1]
+    hs = hidden // num_heads
+    import jax.numpy as jnp
+    positions = starts[:, None] + jnp.arange(c)[None, :]    # [B, C]
+    x = params["wte"][ids] + params["wpe"][positions]
+    flat_slots = write_slots.reshape(b * c)
+    new_pools = []
+    for blk, pool in zip(params["blocks"], pools):
+        h = _serve_ln(x, blk["ln1"])
+        qkv = h @ blk["qkv"][0] + blk["qkv"][1]           # [B, C, 3H]
+        q, k, v = (qkv[..., i * hidden:(i + 1) * hidden]
+                   .reshape(b, c, num_heads, hs) for i in range(3))
+        k_pool = _pool_scatter(pool["k"], flat_slots,
+                               k.reshape(b * c, num_heads, hs))
+        v_pool = _pool_scatter(pool["v"], flat_slots,
+                               v.reshape(b * c, num_heads, hs))
+        new_pools.append({"k": k_pool, "v": v_pool})
+        ctx = paged_prefill_attention(q, k_pool, v_pool, slot_idx,
+                                      starts,
+                                      sm_scale=1.0 / float(np.sqrt(hs)))
+        ctx = ctx.reshape(b, c, hidden)
+        x = x + (ctx @ blk["proj"][0] + blk["proj"][1])
         x = x + _serve_mlp(_serve_ln(x, blk["ln2"]), blk, act)
     x = _serve_ln(x, params["ln_f"])
     return x @ params["lm_head"], new_pools

@@ -24,7 +24,7 @@ __all__ = ["flash_attention_op", "FlashAttentionOp", "attention_reference",
            "ring_attention_op", "RingAttentionOp",
            "ulysses_attention_op", "UlyssesAttentionOp",
            "decode_attention", "prefill_attention",
-           "paged_decode_attention"]
+           "paged_decode_attention", "paged_prefill_attention"]
 
 
 def attention_reference(q, k, v, mask, sm_scale):
@@ -94,6 +94,36 @@ def paged_decode_attention(q, k_pool, v_pool, slot_idx, positions,
     scores = jnp.where(valid[:, None, :], scores, -1e9)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, slot_idx, starts,
+                            sm_scale):
+    """A chunk of query tokens per sequence against a block-paged KV
+    pool — the suffix-prefill analogue of :func:`paged_decode_attention`.
+
+    ``q`` is ``[B, C, H, D]`` — ``C`` consecutive query positions per
+    sequence starting at ``starts[b]`` (0-based); ``k_pool`` /
+    ``v_pool`` are one layer's pooled cache (4D blocked or already
+    flat); ``slot_idx`` is ``[B, S]`` int32 mapping position ``j`` of
+    sequence ``b`` to its flat pool slot. The chunk's own K/V rows must
+    already be scattered into the pool before the call; causality is
+    the mask ``j <= starts[b] + i`` per chunk row ``i``, which makes
+    prefix-cached prefill work unchanged: positions before ``starts``
+    (the cached prefix, or earlier chunks of this prompt) are simply
+    valid history gathered through the block table. Returns
+    ``[B, C, H, D]``."""
+    if k_pool.ndim == 4:
+        k_pool = k_pool.reshape(-1, *k_pool.shape[2:])
+        v_pool = v_pool.reshape(-1, *v_pool.shape[2:])
+    k = k_pool[slot_idx]                                # [B, S, H, D]
+    v = v_pool[slot_idx]
+    scores = jnp.einsum("bihd,bshd->bhis", q * sm_scale, k)
+    pos = starts[:, None] + jnp.arange(q.shape[1])[None, :]   # [B, C]
+    valid = jnp.arange(slot_idx.shape[1])[None, None, :] \
+        <= pos[:, :, None]                              # [B, C, S]
+    scores = jnp.where(valid[:, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhis,bshd->bihd", probs.astype(v.dtype), v)
 
 
 def prefill_attention(q, k, v, sm_scale, causal=True):

@@ -46,7 +46,8 @@ from .batcher import MicroBatcher
 from .decode import GPTDecoder
 from .embedding import ReadOnlyPSClient, serve_embeddings_from_ps
 from .http import ServingHTTPServer
-from .kvcache import BlockAllocator, KVCacheExhausted, PagedKVCache
+from .kvcache import (BlockAllocator, KVCacheExhausted, PagedKVCache,
+                      PrefixCache)
 from .lifecycle import RequestTimeline, mint_request_id
 from .router import ReplicaRouter, RouterOverloaded, SLOWindow
 from .scheduler import ContinuousBatchingEngine, EngineOverloaded
@@ -55,6 +56,7 @@ __all__ = ["InferenceSession", "MicroBatcher", "GPTDecoder",
            "ReadOnlyPSClient", "serve_embeddings_from_ps",
            "ServingHTTPServer", "next_bucket",
            "BlockAllocator", "KVCacheExhausted", "PagedKVCache",
+           "PrefixCache",
            "ContinuousBatchingEngine", "EngineOverloaded",
            "ReplicaRouter", "RouterOverloaded", "SLOWindow",
            "RequestTimeline", "mint_request_id"]

@@ -67,20 +67,26 @@ class RequestTimeline:
         # waiting-episode cursor: submit time initially, reset to the
         # preemption instant when a sequence bounces back to the queue
         self.t_wait_start = now_ns
-        self.t_first_token = None       # TTFT point: first prefill end
-        self.episodes = []              # (phase, t0_ns, t1_ns)
+        self.t_first_token = None       # TTFT point: last prefill end
+        self.episodes = []              # (phase, t0_ns, t1_ns, attrs)
 
-    def note(self, phase, t0_ns, t1_ns):
-        self.episodes.append((phase, t0_ns, t1_ns))
+    def note(self, phase, t0_ns, t1_ns, attrs=None):
+        """Record one episode; ``attrs`` (optional dict) rides onto the
+        exported ``serve_phase`` span — the prefix/chunked-prefill path
+        stamps ``cached_tokens`` / ``computed_tokens`` here so the
+        doctor can attribute prompt work to the cache vs the chip."""
+        self.episodes.append((phase, t0_ns, t1_ns, attrs))
 
 
 def emit_request(tel, tl, t_retire_ns, tokens, preempts):
     """Export one retired request's timeline: one ``serve_phase`` span
     per episode plus the enclosing ``serve_request`` span (attrs typed
     in ``telemetry.check.SPAN_SCHEMA``)."""
-    for phase, t0, t1 in tl.episodes:
-        tel.complete("serve_phase", t0, t1,
-                     {"request_id": tl.rid, "phase": phase})
+    for phase, t0, t1, attrs in tl.episodes:
+        args = {"request_id": tl.rid, "phase": phase}
+        if attrs:
+            args.update(attrs)
+        tel.complete("serve_phase", t0, t1, args)
     tel.complete("serve_request", tl.t_submit, t_retire_ns,
                  {"request_id": tl.rid, "phase": "retired",
                   "tokens": int(tokens), "preempts": int(preempts)})

@@ -43,6 +43,29 @@ written (higher occupancy) and, on exhaustion, **preempts** the
 youngest running sequence: its blocks free, it requeues at the waiting
 head, and because sampling is keyed on ``(seed, token_index)`` the
 recompute reproduces the exact tokens it lost.
+
+**Prefix caching** (``prefix_cache=True``): admission resolves each
+prompt against the pool's :class:`~hetu_tpu.serving.kvcache.PrefixCache`
+— the cached prefix's blocks are *shared* (refcount bumped, zero new
+blocks, zero prefill compute) and only the non-cached suffix is
+allocated, charged, and prefilled (``gpt_paged_suffix_prefill`` starts
+at the first non-cached position). Finished prefills publish their
+blocks back to the cache; retired requests leave cached blocks resident
+for the next hit (LRU-evicted only under allocation pressure). Shared
+blocks are copy-on-write: a sequence extending into one (suffix prefill
+into a shared tail, or the first decode write past a cache-frozen
+prompt tail) copies it first, so sharers never see each other's writes
+— with ``reserve="full"`` admission charges those copies up front and
+the no-mid-decode-exhaustion guarantee stands; a genuine multi-sharer
+shortfall preempts the youngest sequence exactly like lazy exhaustion.
+
+**Chunked prefill** (``prefill_chunk=N``): a prompt longer than ``N``
+non-cached tokens prefills one chunk per engine step, interleaved with
+the running batch's decode, so one long cold prompt no longer stalls
+TPOT for every running sequence. Chunk widths snap to their own pow2
+ladder and the suffix-prefill program keys on (batch, chunk, ctx)
+buckets, so :attr:`compile_bound` stays a finite ladder product —
+HT901 holds with both features on.
 """
 from __future__ import annotations
 
@@ -59,7 +82,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from ..models.gpt import (gpt_paged_prefill, gpt_paged_step,
-                          gpt_serving_params)
+                          gpt_paged_suffix_prefill, gpt_serving_params)
 from . import lifecycle as _lifecycle
 from .kvcache import DEFAULT_BLOCK_SIZE, KVCacheExhausted, PagedKVCache
 from .lifecycle import RequestTimeline, mint_request_id
@@ -103,7 +126,8 @@ def _choose_token(logits_row, temperature, seed, idx):
 class _Seq:
     __slots__ = ("id", "prompt", "max_new", "temperature", "seed",
                  "future", "generated", "pending", "n_written",
-                 "t_submit", "preempts", "rid", "tl", "tokens_lost")
+                 "t_submit", "preempts", "rid", "tl", "tokens_lost",
+                 "cached_tokens", "prefill_pos")
 
     def __init__(self, sid, prompt, max_new, temperature, seed, rid,
                  tl):
@@ -124,6 +148,15 @@ class _Seq:
         # len(generated) <= tokens_lost the sequence is re-earning them
         # (its episodes are "replay", and live introspection says so)
         self.tokens_lost = 0
+        # prompt tokens the prefix cache resolved at admission (their
+        # K/V was already resident — never recomputed)
+        self.cached_tokens = 0
+        # next prompt position to prefill; < len(prompt) means the
+        # sequence is still in (possibly chunked) prefill
+        self.prefill_pos = 0
+
+    def prefilling(self):
+        return self.prefill_pos < self.prompt.shape[0]
 
     def replaying(self):
         return self.tokens_lost > 0 and \
@@ -146,7 +179,8 @@ class ContinuousBatchingEngine:
     def __init__(self, config, lookup, *, num_blocks=None,
                  block_size=DEFAULT_BLOCK_SIZE, budget=None, max_len=None,
                  max_batch_size=8, admission="queue", max_queue=256,
-                 reserve="full", slo_p99_ms=None, slo_error_rate=None,
+                 reserve="full", prefix_cache=False, prefill_chunk=None,
+                 slo_p99_ms=None, slo_error_rate=None,
                  slo_window=128, slo_ttft_p99_ms=None, telemetry=None,
                  name="engine", start=True):
         import jax
@@ -156,6 +190,9 @@ class ContinuousBatchingEngine:
         if reserve not in ("full", "lazy"):
             raise ValueError(f"reserve must be 'full' or 'lazy', "
                              f"got {reserve!r}")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
         self.config = config
         self.max_len = int(max_len or config.max_position_embeddings)
         if self.max_len > config.max_position_embeddings:
@@ -170,16 +207,27 @@ class ContinuousBatchingEngine:
         self.telemetry = _telemetry.resolve(telemetry)
         self.slo = SLOWindow(slo_p99_ms, slo_error_rate, slo_window,
                              ttft_p99_ms=slo_ttft_p99_ms)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk = int(prefill_chunk) \
+            if prefill_chunk is not None else None
+        # prefix hits and chunking both mean "prefill from a token
+        # offset into an existing table" — one suffix-prefill program
+        # serves both, so either knob switches prefill onto it
+        self._suffix_mode = self.prefix_cache \
+            or self.prefill_chunk is not None
         self.params = gpt_serving_params(config, lookup)
         self.cache = PagedKVCache(config, num_blocks=num_blocks,
                                   block_size=block_size, budget=budget,
-                                  telemetry=self.telemetry)
+                                  telemetry=self.telemetry,
+                                  prefix_cache=self.prefix_cache)
         # HT901 ladders: every dispatch dimension snaps to one of these,
         # so signatures stay bounded under per-step churn
         self.batch_buckets = _pow2_ladder(1, self.max_batch_size)
         self.prompt_buckets = _pow2_ladder(1, self.max_len)
         self.ctx_buckets = _pow2_ladder(self.cache.block_size,
                                         self.max_len)
+        self.chunk_buckets = _pow2_ladder(
+            1, min(self.prefill_chunk or self.max_len, self.max_len))
         nh = config.num_attention_heads
         act = getattr(config, "hidden_act", "gelu")
         self._prefill_fn = jax.jit(
@@ -187,6 +235,9 @@ class ContinuousBatchingEngine:
                               hidden_act=act), donate_argnums=(1,))
         self._step_fn = jax.jit(
             functools.partial(gpt_paged_step, num_heads=nh,
+                              hidden_act=act), donate_argnums=(1,))
+        self._sprefill_fn = jax.jit(
+            functools.partial(gpt_paged_suffix_prefill, num_heads=nh,
                               hidden_act=act), donate_argnums=(1,))
         self._signatures = set()
         self._ids = itertools.count()
@@ -226,9 +277,15 @@ class ContinuousBatchingEngine:
     def compile_bound(self):
         """The HT901 ladder-product bound on distinct jit signatures:
         prefill keys on (batch, prompt) buckets, decode on (batch, ctx)
-        buckets — churn can never compile more programs than this."""
-        return len(self.batch_buckets) * (len(self.prompt_buckets)
-                                          + len(self.ctx_buckets))
+        buckets, suffix prefill (prefix cache / chunked prefill) on
+        (batch, chunk, ctx) buckets — churn can never compile more
+        programs than this."""
+        bound = len(self.batch_buckets) * (len(self.prompt_buckets)
+                                           + len(self.ctx_buckets))
+        if self._suffix_mode:
+            bound += (len(self.batch_buckets) * len(self.chunk_buckets)
+                      * len(self.ctx_buckets))
+        return bound
 
     @property
     def jit_compiles(self):
@@ -260,6 +317,7 @@ class ContinuousBatchingEngine:
                  "tokens_done": len(s.generated),
                  "tokens_budget": s.max_new,
                  "kv_blocks": len(tables.get(s.id, ())),
+                 "cached_tokens": s.cached_tokens,
                  "preempts": s.preempts,
                  "age_ms": round((now - s.t_submit) * 1e3, 3)}
                 for s, phase in snap]
@@ -270,20 +328,33 @@ class ContinuousBatchingEngine:
         with self._cond:
             running, waiting = len(self._running), len(self._waiting)
         healthy, reason = self.health()
-        return {"name": self.name,
-                "kind": "ContinuousBatchingEngine",
-                "running": running,
-                "waiting": waiting,
-                "max_batch_size": self.max_batch_size,
-                "admission": self.admission,
-                "reserve": self.reserve,
-                "kv_blocks": self.cache.num_blocks,
-                "kv_blocks_used": self.cache.used_blocks,
-                "kv_hbm_utilization": round(self.cache.utilization, 4),
-                "jit_compiles": self.jit_compiles,
-                "compile_bound": self.compile_bound,
-                "healthy": healthy,
-                "health_reason": reason}
+        out = {"name": self.name,
+               "kind": "ContinuousBatchingEngine",
+               "running": running,
+               "waiting": waiting,
+               "max_batch_size": self.max_batch_size,
+               "admission": self.admission,
+               "reserve": self.reserve,
+               "kv_blocks": self.cache.num_blocks,
+               "kv_blocks_used": self.cache.used_blocks,
+               "kv_hbm_utilization": round(self.cache.utilization, 4),
+               "jit_compiles": self.jit_compiles,
+               "compile_bound": self.compile_bound,
+               "healthy": healthy,
+               "health_reason": reason}
+        out["prefix_cache"] = self.prefix_cache
+        out["prefill_chunk"] = self.prefill_chunk
+        if self.prefix_cache:
+            # utilization above counts only sequence-referenced blocks;
+            # the cached-unreferenced remainder is reclaimable HBM
+            out["kv_blocks_cached"] = self.cache.cached_blocks
+            out["kv_hbm_utilization_cached"] = round(
+                self.cache.cached_utilization, 4)
+            out["serve_prefix_hit_rate"] = round(
+                self.cache.prefix.hit_rate(), 4)
+            out["serve_cow_copies"] = self.cache.cow_copies
+            out["serve_prefix_evictions"] = self.cache.prefix.evictions
+        return out
 
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature=0.0, seed=0,
@@ -350,7 +421,15 @@ class ContinuousBatchingEngine:
         cm = tel.span("step", subgraph="serving_engine") \
             if tel.enabled else contextlib.nullcontext()
         with cm:
-            if admitted:
+            if self._suffix_mode:
+                # chunked/prefix prefill: EVERY still-prefilling
+                # sequence (not just this step's admissions) computes
+                # one chunk, then the running batch decodes — long cold
+                # prompts interleave with decode instead of stalling it
+                prefilling = [s for s in self._running if s.prefilling()]
+                if prefilling:
+                    self._prefill_suffix_step(prefilling)
+            elif admitted:
                 self._prefill_admitted(admitted)
             self._finish_done()
             if self._running:
@@ -362,6 +441,20 @@ class ContinuousBatchingEngine:
             tel.observe(f"{self.name}_batch_width", width)
         return len(self._running)
 
+    def _can_admit_locked(self, seq, reserve_tokens):
+        """Block check for one admission. Without a prefix cache this is
+        plain free-list arithmetic; with one, the request is charged
+        only its non-cached remainder plus the copy-on-write spares its
+        writes into shared blocks will need, against free + evictable
+        blocks (matched blocks excluded — sharing them un-LRUs them
+        before any eviction could touch them)."""
+        if not self.prefix_cache:
+            return self.cache.can_admit(reserve_tokens)
+        need = self.cache.admit_blocks_needed(seq.prompt, reserve_tokens)
+        matched, _ = self.cache.match_prefix(seq.prompt)
+        evictable = max(0, self.cache.cached_blocks - len(matched))
+        return need <= self.cache.allocator.available + evictable
+
     def _admit_locked(self):
         admitted = []
         while self._waiting and \
@@ -370,7 +463,7 @@ class ContinuousBatchingEngine:
             p = seq.prompt.shape[0]
             reserve_tokens = p + (seq.max_new
                                   if self.reserve == "full" else 0)
-            if not self.cache.can_admit(reserve_tokens):
+            if not self._can_admit_locked(seq, reserve_tokens):
                 if self.admission == "reject":
                     self._waiting.popleft()
                     seq.future.set_exception(EngineOverloaded(
@@ -383,7 +476,19 @@ class ContinuousBatchingEngine:
                 # arrivals never jump it (no starvation)
                 break
             self._waiting.popleft()
-            self.cache.add_seq(seq.id, reserve_tokens)
+            if self.prefix_cache:
+                _, cached = self.cache.add_seq_prefix(
+                    seq.id, reserve_tokens, seq.prompt)
+                seq.cached_tokens = cached
+                seq.prefill_pos = cached
+                seq.n_written = cached   # cached rows are resident
+                if cached and self.telemetry.enabled:
+                    self.telemetry.inc(
+                        f"{self.name}_prefill_cached_tokens", cached)
+            else:
+                self.cache.add_seq(seq.id, reserve_tokens)
+                seq.cached_tokens = 0
+                seq.prefill_pos = 0
             admitted.append(seq)
         self._set_depth_locked()
         self._running.extend(admitted)
@@ -454,9 +559,11 @@ class ContinuousBatchingEngine:
                 s.generated.append(tok)
                 s.pending = tok
                 s.n_written = p
+                s.prefill_pos = p
                 if s.tl is not None:
                     s.tl.note("replay" if s.replaying() else "prefill",
-                              t0, t1)
+                              t0, t1, {"cached_tokens": 0,
+                                       "computed_tokens": p})
                     if s.tl.t_first_token is None:
                         s.tl.t_first_token = t1     # TTFT point
             if tel.enabled:
@@ -465,6 +572,114 @@ class ContinuousBatchingEngine:
                 tel.inc(f"{self.name}_prefill_pad_tokens",
                         bb * pb - real)
                 tel.inc(f"{self.name}_tokens", len(group))
+
+    def _cow_or_preempt(self, s, start, stop):
+        """Copy-on-write the blocks positions ``[start, stop)`` touch
+        before ``s`` writes them, preempting the youngest running
+        sequence when the copy can't be allocated (same victim policy
+        as lazy-reserve exhaustion; the victim replays exactly).
+        Returns False when ``s`` itself was the last resort victim."""
+        while True:
+            try:
+                self.cache.ensure_writable(s.id, start, stop)
+                return True
+            except KVCacheExhausted:
+                victim = self._running[-1]
+                self._preempt(victim)
+                if victim is s:
+                    return False
+
+    def _prefill_suffix_step(self, prefilling):
+        """One chunk of prompt prefill per still-prefilling sequence:
+        the prefix-cache/chunked path (``gpt_paged_suffix_prefill``).
+        Each sequence computes ``min(prefill_chunk, remaining)`` tokens
+        from its ``prefill_pos`` — the first non-cached position on a
+        fresh admission — grouped per chunk bucket; the final chunk
+        samples token 0 and publishes the prompt's blocks to the prefix
+        cache."""
+        import jax.numpy as jnp
+        tel = self.telemetry
+        chunk = self.prefill_chunk or self.max_len
+        groups = {}
+        for s in prefilling:
+            if s not in self._running:
+                continue        # preempted by an earlier group's CoW
+            w = min(chunk, s.prompt.shape[0] - s.prefill_pos)
+            # shared blocks this chunk writes into copy FIRST, so the
+            # write slots below point at private storage
+            if not self._cow_or_preempt(s, s.prefill_pos,
+                                        s.prefill_pos + w):
+                continue
+            cw = next_bucket(w, self.chunk_buckets)
+            groups.setdefault(cw, []).append((s, w))
+        for cw, group in sorted(groups.items()):
+            group = [(s, w) for s, w in group if s in self._running]
+            if not group:
+                continue
+            bb = next_bucket(len(group), self.batch_buckets)
+            sb = next_bucket(max(s.prefill_pos + w for s, w in group),
+                             self.ctx_buckets)
+            ids = np.zeros((bb, cw), np.int32)
+            starts = np.zeros(bb, np.int32)
+            write_slots = np.zeros((bb, cw), np.int32)  # 0 = scratch
+            slot_grid = np.zeros((bb, sb), np.int32)
+            slot_grid[:len(group)] = self.cache.gather_slots(
+                [s.id for s, _ in group], sb)
+            for i, (s, w) in enumerate(group):
+                pos = s.prefill_pos
+                ids[i, :w] = s.prompt[pos:pos + w]
+                ids[i, w:] = s.prompt[pos + w - 1]   # edge pad in-vocab
+                starts[i] = pos
+                write_slots[i, :w] = self.cache.slot_mapping(
+                    s.id, pos, pos + w)
+            t0 = time.perf_counter_ns() if tel.enabled else 0
+            logits, pools = self._dispatch(
+                ("sprefill", bb, cw, sb), self._sprefill_fn,
+                self.params, self.cache.pools, jnp.asarray(ids),
+                jnp.asarray(starts), jnp.asarray(slot_grid),
+                jnp.asarray(write_slots))
+            self.cache.pools = pools
+            finishing = [(i, s, w) for i, (s, w) in enumerate(group)
+                         if s.prefill_pos + w >= s.prompt.shape[0]]
+            last = np.asarray(
+                logits[jnp.asarray([i for i, _, _ in finishing]),
+                       jnp.asarray([w - 1 for _, _, w in finishing])]) \
+                if finishing else None
+            t1 = time.perf_counter_ns() if tel.enabled else 0
+            for j, (i, s, w) in enumerate(finishing):
+                tok = _choose_token(last[j], s.temperature, s.seed, 0)
+                s.generated.append(tok)
+                s.pending = tok
+            cached_resolved = 0
+            for i, (s, w) in enumerate(group):
+                first_chunk = s.prefill_pos == s.cached_tokens
+                if first_chunk:
+                    cached_resolved += s.cached_tokens
+                s.prefill_pos += w
+                s.n_written = s.prefill_pos
+                if s.tl is not None:
+                    s.tl.note(
+                        "replay" if s.replaying() else "prefill", t0, t1,
+                        {"cached_tokens": s.cached_tokens
+                         if first_chunk else 0, "computed_tokens": w})
+                if not s.prefilling():
+                    # prompt fully resident: publish it for later hits
+                    # (the cache freezes these blocks; the first decode
+                    # write past the tail copy-on-writes)
+                    self.cache.insert_prefix(s.id, s.prompt)
+                    if s.tl is not None and s.tl.t_first_token is None:
+                        s.tl.t_first_token = t1     # TTFT point
+            if tel.enabled:
+                computed = sum(w for _, w in group)
+                tel.complete("serve_prefill_chunk", t0, t1,
+                             {"seqs": len(group),
+                              "tokens": int(computed),
+                              "bucket": int(cw),
+                              "cached": int(cached_resolved)})
+                tel.inc(f"{self.name}_prefill_tokens", computed)
+                tel.inc(f"{self.name}_prefill_pad_tokens",
+                        bb * cw - computed)
+                tel.inc(f"{self.name}_tokens", len(finishing))
 
     def _ensure_capacity_lazy(self, active):
         """Lazy-reserve growth: make every active sequence's table
@@ -493,6 +708,8 @@ class ContinuousBatchingEngine:
         victim.generated = []
         victim.pending = None
         victim.n_written = 0
+        victim.prefill_pos = 0
+        victim.cached_tokens = 0
         victim.preempts += 1
         with self._cond:
             self._running.remove(victim)
@@ -512,9 +729,18 @@ class ContinuousBatchingEngine:
     def _decode_once(self):
         import jax.numpy as jnp
         active = [s for s in self._running
-                  if len(s.generated) < s.max_new]
+                  if len(s.generated) < s.max_new
+                  and not s.prefilling()]
         if self.reserve == "lazy":
             active = self._ensure_capacity_lazy(active)
+        if self.prefix_cache:
+            # the first write past a cached/frozen prompt tail lands in
+            # a shared block — copy it before computing write slots
+            # (reserve="full" admission pre-charged this block)
+            for s in list(active):
+                if s in self._running:
+                    self._cow_or_preempt(s, s.n_written, s.n_written + 1)
+            active = [s for s in active if s in self._running]
         if not active:
             return
         bb = next_bucket(len(active), self.batch_buckets)
@@ -578,7 +804,8 @@ class ContinuousBatchingEngine:
                         (t_retire - s.tl.t_first_token) / 1e6
                         / max(1, len(s.generated) - 1))
                 tel.observe("serve_queue_wait_ms",
-                            sum(t1 - t0 for ph, t0, t1 in s.tl.episodes
+                            sum(t1 - t0
+                                for ph, t0, t1, _ in s.tl.episodes
                                 if ph == "queue") / 1e6)
                 tel.observe("serve_preempts", s.preempts)
             self.slo.note(True, ms, ttft_ms=ttft_ms)

@@ -125,9 +125,19 @@ SPAN_SCHEMA = {
     # no open payload.
     "serve_request": {"request_id": _req(_STR), "tokens": _req(_INT),
                       "preempts": _req(_INT), "phase": _opt(_STR)},
+    # prefill episodes under the prefix cache split prompt tokens into
+    # cache-resolved vs chip-computed (admission charged only the
+    # latter) — the doctor's cache-efficacy attribution keys on these
     "serve_phase": {"request_id": _req(_STR), "phase": _req(_STR),
-                    "tokens": _opt(_INT)},
+                    "tokens": _opt(_INT), "cached_tokens": _opt(_INT),
+                    "computed_tokens": _opt(_INT)},
     "serve_preempt": {"request_id": _req(_STR), "tokens": _opt(_INT)},
+    # one span per chunked/suffix prefill dispatch (scheduler.py
+    # _prefill_suffix_step): seqs in the group, computed (real, unpadded)
+    # tokens, the pow2 chunk bucket dispatched, and prefix-cache tokens
+    # resolved for sequences on their first chunk
+    "serve_prefill_chunk": {"seqs": _req(_INT), "tokens": _req(_INT),
+                            "bucket": _opt(_INT), "cached": _opt(_INT)},
     # autotuner / probe (tune/)
     "autotune_sweep": {"kernel": _req(_STR), "key": _req(_STR),
                        "chosen": _req(_STR), "picked_ms": _req(_NUM),

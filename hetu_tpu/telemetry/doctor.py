@@ -370,14 +370,33 @@ def _account_request(r, tolerance, slack_us=2.0):
     buckets = {b: 0.0 for b in SERVE_BUCKETS}
     seen = set()
     in_window = True
-    prefill_end = None
-    for ph, s, t in r["episodes"]:
+    prefill_ends, decode_starts = [], []
+    cached_tokens = computed_tokens = 0
+    for ph, s, t, a in r["episodes"]:
         buckets[ph] = buckets.get(ph, 0.0) + (t - s)
         seen.add(ph)
         if s < t0 - slack_us or t > t1 + slack_us:
             in_window = False
-        if ph == "prefill" and (prefill_end is None or t < prefill_end):
-            prefill_end = t         # FIRST prefill end = TTFT point
+        if ph == "prefill":
+            prefill_ends.append(t)
+            try:
+                cached_tokens += int(a.get("cached_tokens", 0))
+                computed_tokens += int(a.get("computed_tokens", 0))
+            except (TypeError, ValueError):
+                pass
+        elif ph == "decode":
+            decode_starts.append(s)
+    # TTFT point: the LAST prefill end that precedes the first decode
+    # start — under chunked prefill a prompt spans several prefill
+    # episodes and the first token only exists once the final chunk
+    # lands (the first-episode end would fake a fast TTFT)
+    first_decode = min(decode_starts) if decode_starts else None
+    prefill_end = None
+    for t in prefill_ends:
+        if first_decode is not None and t > first_decode + slack_us:
+            continue
+        if prefill_end is None or t > prefill_end:
+            prefill_end = t
     claimed = sum(v for b, v in buckets.items() if b != "overhead")
     residual = e2e_us - claimed
     conserved = in_window and \
@@ -403,6 +422,8 @@ def _account_request(r, tolerance, slack_us=2.0):
         "ttft_ms": None if ttft_ms is None else round(ttft_ms, 3),
         "tpot_ms": None if tpot_ms is None else round(tpot_ms, 4),
         "queue_ms": round(buckets["queue"] / 1000.0, 3),
+        "cached_tokens": cached_tokens,
+        "computed_tokens": computed_tokens,
         "complete": bool(complete),
         "conserved": bool(conserved),
     }
@@ -435,7 +456,8 @@ def parse_request_events(events, tolerance=0.05):
         else:
             ph = args.get("phase")
             if isinstance(ph, str):
-                r["episodes"].append((ph, e["ts"], e["ts"] + e["dur"]))
+                r["episodes"].append((ph, e["ts"], e["ts"] + e["dur"],
+                                      args))
     return [_account_request(r, tolerance) for r in reqs.values()
             if r["e2e"] is not None]
 
@@ -446,9 +468,12 @@ _SERVE_REMEDY = {
     "queue": "admission-starved: raise ContinuousBatchingEngine "
              "num_blocks (a bigger KV pool admits sooner) or "
              "max_batch_size, or add a replica behind ReplicaRouter",
-    "prefill": "TTFT rides prompt-bucket padding: tighter "
-               "prompt_buckets (compare engine_prefill_pad_tokens vs "
-               "engine_prefill_tokens), or shorten prompts",
+    "prefill": "TTFT rides prefill compute: prefix_cache=True shares "
+               "repeated system-prompt K/V (prefill_cached_tokens vs "
+               "prefill_tokens shows the resolved fraction) and "
+               "prefill_chunk=N interleaves long cold prompts with "
+               "decode; also compare engine_prefill_pad_tokens vs "
+               "engine_prefill_tokens for prompt-bucket padding",
     "decode": "decode-compute bound: the device is the limit — raise "
               "max_batch_size for step occupancy, or scale replicas",
     "replay": "preemption replay recomputes lost tokens: "
@@ -498,6 +523,11 @@ def summarize_requests(reqs, tolerance=0.05):
                             for b, v in totals.items()},
         "preempted_requests": preempted,
         "preempt_rate": round(preempted / len(reqs), 4),
+        # prefix-cache efficacy across retired requests: prompt tokens
+        # the cache resolved vs tokens the chip actually prefilled
+        "prefill_cached_tokens": sum(r["cached_tokens"] for r in reqs),
+        "prefill_computed_tokens": sum(r["computed_tokens"]
+                                       for r in reqs),
         "replay_fraction": round(totals["replay"] / e2e_total, 4),
         "top_bucket": {
             "bucket": top[0],

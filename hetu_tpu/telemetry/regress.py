@@ -52,6 +52,13 @@ _FIELD_DIRECTION = {"overlap_fraction": False, "ingest_wait_ms": True,
                     "serve_ttft_p99_ms": True,
                     "serve_tpot_p50_ms": True,
                     "serve_queue_wait_p99_ms": True,
+                    # prefix-cache efficacy (bench_serving_prefix):
+                    # token-weighted share of prompt tokens the cache
+                    # resolved instead of prefilling — higher; a drop
+                    # means the cache stopped matching (keying or
+                    # eviction regression), which silently re-inflates
+                    # TTFT and prefill FLOPs
+                    "serve_prefix_hit_rate": False,
                     # fault-tolerant PS fields (bench_wdl_ps_scale):
                     # scale_vs_1s is the 4-server/1-server throughput
                     # ratio — higher; spill_hit_rate is the share of

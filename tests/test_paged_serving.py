@@ -52,22 +52,31 @@ def _drive(engine, futures, limit=500):
 # ---------------------------------------------------------------------------
 
 def test_block_allocator_stress_no_leaks():
-    """Alloc/free/reuse cycles leak no blocks; exhaustion raises the
-    documented error WITHOUT allocating anything (all-or-nothing) and
-    without touching live allocations; reuse is deterministic."""
+    """Alloc/share/release/free cycles (the refcounted prefix-sharing
+    shape) leak no blocks and leave no dangling refcounts: a shadow
+    refcount model tracks every operation and the allocator must agree
+    with it at every step; exhaustion raises the documented error
+    WITHOUT allocating anything (all-or-nothing) and without touching
+    live allocations; reuse is deterministic."""
     a = BlockAllocator(8, 4, first_id=1)
     rng = np.random.RandomState(0)
-    live = []
-    for _ in range(200):
-        if live and rng.rand() < 0.5:
-            blocks = live.pop(rng.randint(len(live)))
-            a.free(blocks)
+    refs = []       # one entry per outstanding reference: a block list
+    for _ in range(400):
+        r = rng.rand()
+        if refs and r < 0.35:
+            a.free(refs.pop(rng.randint(len(refs))))
+        elif refs and r < 0.55:
+            # share an existing allocation (a prefix hit / CoW source
+            # taking its own reference to the same physical blocks)
+            blocks = refs[rng.randint(len(refs))]
+            a.share(blocks)
+            refs.append(list(blocks))
         else:
             n = int(rng.randint(1, 4))
             if n <= a.available:
                 got = a.alloc(n)
                 assert len(got) == n
-                live.append(got)
+                refs.append(got)
             else:
                 used_before = a.used
                 with pytest.raises(KVCacheExhausted):
@@ -75,17 +84,45 @@ def test_block_allocator_stress_no_leaks():
                 # all-or-nothing: the failed alloc took nothing and
                 # corrupted no neighbor
                 assert a.used == used_before
-        flat = [b for blocks in live for b in blocks]
-        assert len(flat) == len(set(flat)), "block double-assigned"
-        assert a.used == len(flat)
-        assert a.available == 8 - len(flat)
-    for blocks in live:
+        # zero drift between the shadow model and the allocator: every
+        # live block's refcount equals its outstanding references, no
+        # block is live without a reference (leak) or referenced while
+        # free (dangling)
+        want = {}
+        for blocks in refs:
+            for b in blocks:
+                want[b] = want.get(b, 0) + 1
+        assert want == {b: a.refcount(b) for b in want}
+        assert a.used == len(want)
+        assert a.available == 8 - len(want)
+    for blocks in refs:
         a.free(blocks)
     assert a.used == 0 and a.available == 8
     # deterministic reuse: freed-in-any-order blocks come back sorted
     assert a.alloc(8) == list(range(1, 9))
     with pytest.raises(ValueError):
         a.free([3, 3])          # double free within one call
+
+
+def test_block_allocator_refcount_underflow_raises():
+    """free() validates BEFORE mutating: releasing more references than
+    a block holds (double free of a shared block, refcount underflow)
+    raises and changes nothing; share() of a dead block raises."""
+    a = BlockAllocator(4, 4, first_id=1)
+    blocks = a.alloc(2)
+    a.share(blocks)                     # refcount 2 each
+    with pytest.raises(ValueError, match="double free"):
+        a.free(blocks + blocks + blocks)    # 3 releases vs 2 held
+    assert all(a.refcount(b) == 2 for b in blocks), \
+        "failed free mutated refcounts"
+    assert a.free(blocks) == []         # refcount 2 -> 1: none freed
+    freed = a.free(blocks)              # refcount 1 -> 0: both freed
+    assert sorted(freed) == sorted(blocks)
+    with pytest.raises(ValueError, match="double free"):
+        a.free([blocks[0]])             # dead block
+    with pytest.raises(ValueError, match="non-live"):
+        a.share([blocks[0]])            # can't share a free block
+    assert a.used == 0 and a.available == 4
 
 
 def test_paged_cache_tables_disjoint_and_scratch_reserved():

@@ -44,7 +44,8 @@ def _request_spans(rid, t0, episodes, tokens=5, preempts=0):
 
 def test_attribution_math_exact():
     """Known episode durations -> exact buckets; overhead is the exact
-    residual; TTFT is the FIRST prefill end; conservation holds."""
+    residual; TTFT is the last prefill end before decode starts (the
+    only prefill end here); conservation holds."""
     rid = "synth-1"
     evs = _request_spans(rid, 1000, [
         ("queue", 1000, 3000),          # 2 ms
@@ -90,6 +91,60 @@ def test_replay_bucket_and_preempt_stats():
     assert diag["preempted_requests"] == 1 and diag["preempt_rate"] == 1.0
     assert diag["buckets_ms"]["replay"] == 4.0
     assert diag["replay_fraction"] == pytest.approx(4.0 / 9.0, abs=1e-3)
+
+
+def test_chunked_prefill_ttft_is_final_chunk_end():
+    """Under chunked prefill a prompt spans SEVERAL prefill episodes;
+    the first token only exists once the final chunk lands, so TTFT is
+    the LAST prefill end preceding the first decode start — the
+    first-episode end would fake a 3x-better TTFT here."""
+    rid = "synth-chunk"
+    evs = _request_spans(rid, 0, [
+        ("queue", 0, 1000),
+        ("prefill", 1000, 2000),        # chunk 1
+        ("prefill", 2500, 3500),        # chunk 2 (decode of others ran
+        ("prefill", 4000, 6000),        # chunk 3  in the 500µs gaps)
+        ("decode", 6000, 7000),
+        ("decode", 7000, 8000),
+    ])
+    evs.append(_span("serve_request", 0, 9000, request_id=rid,
+                     phase="retired", tokens=3, preempts=0))
+    (r,) = parse_request_events(evs)
+    assert r["conserved"] and r["complete"]
+    assert r["ttft_ms"] == 6.0, \
+        "TTFT must be the FINAL chunk's end, not the first's"
+    assert r["buckets_ms"]["prefill"] == 4.0
+    # TPOT spans first token -> retire over tokens-1
+    assert r["tpot_ms"] == pytest.approx(3.0 / 2)
+
+
+def test_prefill_cached_vs_computed_attribution():
+    """serve_phase prefill episodes carry the cached/computed token
+    split; the doctor rolls both up per request and fleet-wide so cache
+    efficacy is auditable from the trace alone."""
+    evs = []
+    for rid, cached, computed in (("r-cold", 0, 20), ("r-hot", 16, 4)):
+        t0 = 0
+        evs += _request_spans(rid, t0, [("queue", 0, 500)])
+        evs.append(_span("serve_phase", 500, 1000, request_id=rid,
+                         phase="prefill", cached_tokens=cached,
+                         computed_tokens=computed))
+        evs.append(_span("serve_phase", 1500, 500, request_id=rid,
+                         phase="decode"))
+        evs.append(_span("serve_request", 0, 2500, request_id=rid,
+                         phase="retired", tokens=2, preempts=0))
+    reqs = {r["request_id"]: r for r in parse_request_events(evs)}
+    assert reqs["r-cold"]["cached_tokens"] == 0
+    assert reqs["r-cold"]["computed_tokens"] == 20
+    assert reqs["r-hot"]["cached_tokens"] == 16
+    assert reqs["r-hot"]["computed_tokens"] == 4
+    diag = summarize_requests(list(reqs.values()))
+    assert diag["prefill_cached_tokens"] == 16
+    assert diag["prefill_computed_tokens"] == 24
+    # the prefill remedy names the knobs that fix a prefill-bound fleet
+    from hetu_tpu.telemetry.doctor import _SERVE_REMEDY
+    assert "prefix_cache" in _SERVE_REMEDY["prefill"]
+    assert "prefill_chunk" in _SERVE_REMEDY["prefill"]
 
 
 def test_overclaim_fails_conservation():
@@ -159,11 +214,17 @@ def test_serve_span_fixtures_validate(tmp_path):
         _span("serve_request", 0, 200, request_id="r1", phase="retired",
               tokens=4, preempts=1),
         _span("serve_preempt", 50, 0, request_id="r1", tokens=3),
+        # chunked-prefill dispatch span + prefill episode carrying the
+        # cached/computed token split
+        _span("serve_prefill_chunk", 100, 400, seqs=2, tokens=14,
+              bucket=8, cached=9),
+        _span("serve_phase", 100, 400, request_id="r1", phase="prefill",
+              cached_tokens=9, computed_tokens=5),
     ]
     p = tmp_path / "trace_rank0.json"
     p.write_text(json.dumps({"traceEvents": evs}))
     n, errors = validate(str(p))
-    assert n == 3 and errors == [], errors
+    assert n == 5 and errors == [], errors
 
 
 def test_serve_span_schema_rejects_drift():
@@ -182,6 +243,24 @@ def test_serve_span_schema_rejects_drift():
     errs = check_args("serve_preempt", {"request_id": "r",
                                         "tokens": True})
     assert any("tokens" in e for e in errs)
+    # chunked-prefill spans: unknown attr / dropped required / bool-int
+    errs = check_args("serve_prefill_chunk", {"seqs": 1, "tokens": 8,
+                                              "hit_rate": 0.5})
+    assert errs and "unknown attr" in errs[0]
+    errs = check_args("serve_prefill_chunk", {"seqs": 1})
+    assert any("tokens" in e and "missing" in e for e in errs)
+    errs = check_args("serve_prefill_chunk", {"seqs": 1, "tokens": 8,
+                                              "cached": True})
+    assert any("cached" in e for e in errs)
+    # prefill attribution attrs validate clean and reject drift
+    assert check_args("serve_phase", {"request_id": "r",
+                                      "phase": "prefill",
+                                      "cached_tokens": 9,
+                                      "computed_tokens": 5}) == []
+    errs = check_args("serve_phase", {"request_id": "r",
+                                      "phase": "prefill",
+                                      "cached_tokens": "lots"})
+    assert any("cached_tokens" in e and "type" in e for e in errs)
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +400,8 @@ def test_regress_directions_for_serving_fields():
                   "serve_queue_wait_p99_ms"):
         assert regress._FIELD_DIRECTION[field] is True, \
             f"{field} must be lower-is-better"
+    # a dropping prefix hit rate is a regression, not an improvement
+    assert regress._FIELD_DIRECTION["serve_prefix_hit_rate"] is False
 
     base = {"serving_tokens_per_sec_per_chip": {
         "metric": "serving_tokens_per_sec_per_chip", "value": 400.0,
